@@ -1,0 +1,92 @@
+"""Error catalog, mirroring the reference's public errors (pilosa.go:26-147)."""
+
+import re
+
+
+class PilosaError(Exception):
+    """Base class for all framework errors."""
+
+
+class IndexExistsError(PilosaError):
+    pass
+
+
+class IndexNotFoundError(PilosaError):
+    pass
+
+
+class FieldExistsError(PilosaError):
+    pass
+
+
+class FieldNotFoundError(PilosaError):
+    pass
+
+
+class BSIGroupNotFoundError(PilosaError):
+    pass
+
+
+class BSIGroupExistsError(PilosaError):
+    pass
+
+
+class InvalidBSIGroupTypeError(PilosaError):
+    pass
+
+
+class InvalidBSIGroupRangeError(PilosaError):
+    pass
+
+
+class InvalidViewError(PilosaError):
+    pass
+
+
+class InvalidCacheTypeError(PilosaError):
+    pass
+
+
+class InvalidFieldTypeError(PilosaError):
+    pass
+
+
+class InvalidTimeQuantumError(PilosaError):
+    pass
+
+
+class FragmentNotFoundError(PilosaError):
+    pass
+
+
+class QueryError(PilosaError):
+    pass
+
+
+class TooManyWritesError(PilosaError):
+    pass
+
+
+class ClusterDoesNotOwnShardError(PilosaError):
+    pass
+
+
+class NodeIDNotExistsError(PilosaError):
+    pass
+
+
+class ColumnRowOutOfRangeError(PilosaError):
+    pass
+
+
+class TranslateStoreReadOnlyError(PilosaError):
+    pass
+
+
+# Name validation (reference: pilosa.go validateName, ^[a-z][a-z0-9_-]{0,63}$).
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,63}$")
+
+
+def validate_name(name: str) -> None:
+    if not _NAME_RE.match(name or ""):
+        raise PilosaError(f"invalid index or field name: {name!r}")

@@ -1,0 +1,401 @@
+"""Host-side 64-bit bitmap with roaring-compatible serialization.
+
+This is the *cold* / interchange representation: the on-disk format is
+byte-compatible with the reference's roaring files (cookie 12348; see
+/root/reference/roaring/roaring.go:29-64 WriteTo/UnmarshalBinary and
+docs/architecture.md). On-device compute never touches this structure —
+fragments materialize dense uint32 bitplanes in HBM (see ops/bitplane.py);
+this class exists for persistence, imports, WAL replay, and as a numpy
+oracle for kernel tests.
+
+Internally every container is held uniformly as a sorted np.uint16 array
+(no array/bitmap/run polymorphism at rest — that branch-heavy representation
+is exactly what we do NOT want near the compute path). The 3-way form is
+chosen only at serialization time, picking the smallest encoding, which any
+roaring reader (including the reference's) accepts.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+MAGIC_NUMBER = 12348
+STORAGE_VERSION = 0
+COOKIE = MAGIC_NUMBER + (STORAGE_VERSION << 16)
+HEADER_BASE_SIZE = 8
+BITMAP_N = (1 << 16) // 64  # words per serialized bitmap container
+
+CONTAINER_ARRAY = 1
+CONTAINER_BITMAP = 2
+CONTAINER_RUN = 3
+
+ARRAY_MAX_SIZE = 4096
+RUN_MAX_SIZE = 2048
+
+OP_ADD = 0
+OP_REMOVE = 1
+OP_SIZE = 1 + 8 + 4
+
+
+def fnv32a(data: bytes) -> int:
+    h = 2166136261
+    for b in data:
+        h ^= b
+        h = (h * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def _empty() -> np.ndarray:
+    return np.empty(0, dtype=np.uint16)
+
+
+class Bitmap:
+    """Sorted-container bitmap over uint64 values."""
+
+    __slots__ = ("containers", "op_n")
+
+    def __init__(self, values=None):
+        # key (value >> 16) -> sorted unique np.uint16 array of low bits
+        self.containers: Dict[int, np.ndarray] = {}
+        self.op_n = 0
+        if values is not None:
+            self.add_many(np.asarray(values, dtype=np.uint64))
+
+    # ------------------------------------------------------------------ basic
+
+    def add(self, value: int) -> bool:
+        key, low = value >> 16, np.uint16(value & 0xFFFF)
+        c = self.containers.get(key)
+        if c is None:
+            self.containers[key] = np.array([low], dtype=np.uint16)
+            return True
+        i = int(np.searchsorted(c, low))
+        if i < len(c) and c[i] == low:
+            return False
+        self.containers[key] = np.insert(c, i, low)
+        return True
+
+    def remove(self, value: int) -> bool:
+        key, low = value >> 16, np.uint16(value & 0xFFFF)
+        c = self.containers.get(key)
+        if c is None:
+            return False
+        i = int(np.searchsorted(c, low))
+        if i >= len(c) or c[i] != low:
+            return False
+        c = np.delete(c, i)
+        if len(c) == 0:
+            del self.containers[key]
+        else:
+            self.containers[key] = c
+        return True
+
+    def contains(self, value: int) -> bool:
+        key, low = value >> 16, np.uint16(value & 0xFFFF)
+        c = self.containers.get(key)
+        if c is None:
+            return False
+        i = int(np.searchsorted(c, low))
+        return i < len(c) and c[i] == low
+
+    def add_many(self, values: np.ndarray) -> None:
+        if len(values) == 0:
+            return
+        values = np.unique(np.asarray(values, dtype=np.uint64))
+        keys = values >> np.uint64(16)
+        lows = (values & np.uint64(0xFFFF)).astype(np.uint16)
+        boundaries = np.flatnonzero(np.diff(keys)) + 1
+        starts = np.concatenate(([0], boundaries))
+        ends = np.concatenate((boundaries, [len(values)]))
+        for s, e in zip(starts, ends):
+            key = int(keys[s])
+            chunk = lows[s:e]
+            c = self.containers.get(key)
+            if c is None:
+                self.containers[key] = chunk.copy()
+            else:
+                self.containers[key] = np.union1d(c, chunk)
+
+    def remove_many(self, values: np.ndarray) -> None:
+        if len(values) == 0:
+            return
+        values = np.unique(np.asarray(values, dtype=np.uint64))
+        keys = values >> np.uint64(16)
+        lows = (values & np.uint64(0xFFFF)).astype(np.uint16)
+        boundaries = np.flatnonzero(np.diff(keys)) + 1
+        starts = np.concatenate(([0], boundaries))
+        ends = np.concatenate((boundaries, [len(values)]))
+        for s, e in zip(starts, ends):
+            key = int(keys[s])
+            c = self.containers.get(key)
+            if c is None:
+                continue
+            c = np.setdiff1d(c, lows[s:e], assume_unique=True)
+            if len(c) == 0:
+                self.containers.pop(key, None)
+            else:
+                self.containers[key] = c
+
+    def count(self) -> int:
+        return sum(len(c) for c in self.containers.values())
+
+    def any(self) -> bool:
+        return bool(self.containers)
+
+    def max(self) -> int:
+        if not self.containers:
+            return 0
+        key = max(self.containers)
+        return (key << 16) | int(self.containers[key][-1])
+
+    def count_range(self, start: int, end: int) -> int:
+        """Number of set bits in [start, end)."""
+        n = 0
+        skey, ekey = start >> 16, end >> 16
+        for key in self.containers:
+            if key < skey or key > ekey:
+                continue
+            c = self.containers[key]
+            lo = np.searchsorted(c, np.uint16(start & 0xFFFF)) if key == skey else 0
+            hi = np.searchsorted(c, np.uint16(end & 0xFFFF)) if key == ekey else len(c)
+            n += int(hi - lo)
+        return n
+
+    def slice(self) -> np.ndarray:
+        """All set values, ascending, as uint64."""
+        if not self.containers:
+            return np.empty(0, dtype=np.uint64)
+        parts = []
+        for key in sorted(self.containers):
+            c = self.containers[key]
+            parts.append((np.uint64(key) << np.uint64(16)) | c.astype(np.uint64))
+        return np.concatenate(parts)
+
+    def slice_range(self, start: int, end: int) -> np.ndarray:
+        """Set values in [start, end), ascending."""
+        vals = self.slice()
+        lo = np.searchsorted(vals, np.uint64(start))
+        hi = np.searchsorted(vals, np.uint64(end))
+        return vals[lo:hi]
+
+    def __iter__(self) -> Iterator[int]:
+        for v in self.slice():
+            yield int(v)
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Bitmap):
+            return NotImplemented
+        if set(self.containers) != set(other.containers):
+            return False
+        return all(
+            np.array_equal(c, other.containers[k]) for k, c in self.containers.items()
+        )
+
+    def __len__(self) -> int:
+        return self.count()
+
+    def clone(self) -> "Bitmap":
+        b = Bitmap()
+        b.containers = {k: c.copy() for k, c in self.containers.items()}
+        return b
+
+    # ------------------------------------------------------ set algebra (oracle)
+
+    def _binop(self, other: "Bitmap", fn) -> "Bitmap":
+        out = Bitmap()
+        for key in set(self.containers) | set(other.containers):
+            a = self.containers.get(key, _empty())
+            b = other.containers.get(key, _empty())
+            c = fn(a, b)
+            if len(c):
+                out.containers[key] = c.astype(np.uint16)
+        return out
+
+    def union(self, other: "Bitmap") -> "Bitmap":
+        return self._binop(other, np.union1d)
+
+    def intersect(self, other: "Bitmap") -> "Bitmap":
+        return self._binop(other, lambda a, b: np.intersect1d(a, b, assume_unique=True))
+
+    def difference(self, other: "Bitmap") -> "Bitmap":
+        return self._binop(other, lambda a, b: np.setdiff1d(a, b, assume_unique=True))
+
+    def xor(self, other: "Bitmap") -> "Bitmap":
+        return self._binop(other, np.setxor1d)
+
+    def intersection_count(self, other: "Bitmap") -> int:
+        n = 0
+        for key, a in self.containers.items():
+            b = other.containers.get(key)
+            if b is not None:
+                n += len(np.intersect1d(a, b, assume_unique=True))
+        return n
+
+    def flip(self, start: int, end: int) -> "Bitmap":
+        """Logical negate of bits in [start, end] (inclusive, as reference)."""
+        out = self.clone()
+        rng = np.arange(start, end + 1, dtype=np.uint64)
+        present = np.isin(rng, self.slice_range(start, end + 1))
+        out.remove_many(rng[present])
+        out.add_many(rng[~present])
+        return out
+
+    def offset_range(self, offset: int, start: int, end: int) -> "Bitmap":
+        """Bits in [start, end) rebased to offset (reference roaring.go:311).
+
+        offset/start/end must be container-aligned (multiples of 2^16).
+        """
+        if offset & 0xFFFF or start & 0xFFFF or end & 0xFFFF:
+            raise ValueError("offset_range arguments must be container-aligned")
+        off_key, s_key, e_key = offset >> 16, start >> 16, end >> 16
+        out = Bitmap()
+        for key, c in self.containers.items():
+            if s_key <= key < e_key:
+                out.containers[off_key + (key - s_key)] = c.copy()
+        return out
+
+    # ---------------------------------------------------------- serialization
+
+    @staticmethod
+    def _runs(c: np.ndarray) -> np.ndarray:
+        """Sorted uint16 array -> (r, 2) [start, last] inclusive run pairs."""
+        if len(c) == 0:
+            return np.empty((0, 2), dtype=np.uint16)
+        brk = np.flatnonzero(np.diff(c.astype(np.int32)) != 1)
+        starts = np.concatenate(([0], brk + 1))
+        lasts = np.concatenate((brk, [len(c) - 1]))
+        return np.stack([c[starts], c[lasts]], axis=1)
+
+    def to_bytes(self) -> bytes:
+        keys = sorted(k for k, c in self.containers.items() if len(c))
+        buf = io.BytesIO()
+        buf.write(struct.pack("<II", COOKIE, len(keys)))
+
+        # Pick the smallest of array / bitmap / run per container.
+        payloads = []
+        for key in keys:
+            c = self.containers[key]
+            n = len(c)
+            runs = self._runs(c)
+            sizes = {
+                CONTAINER_ARRAY: 2 * n,
+                CONTAINER_BITMAP: 8 * BITMAP_N,
+                CONTAINER_RUN: 2 + 4 * len(runs),
+            }
+            if len(runs) > RUN_MAX_SIZE:
+                del sizes[CONTAINER_RUN]
+            if n > ARRAY_MAX_SIZE:
+                del sizes[CONTAINER_ARRAY]
+            typ = min(sizes, key=lambda t: (sizes[t], t))
+            if typ == CONTAINER_ARRAY:
+                data = c.astype("<u2").tobytes()
+            elif typ == CONTAINER_RUN:
+                data = struct.pack("<H", len(runs)) + runs.astype("<u2").tobytes()
+            else:
+                words = np.zeros(BITMAP_N, dtype=np.uint64)
+                idx = c.astype(np.uint32)
+                np.bitwise_or.at(
+                    words, idx >> 6, np.uint64(1) << (idx & np.uint32(63)).astype(np.uint64)
+                )
+                data = words.astype("<u8").tobytes()
+            payloads.append((key, typ, n, data))
+            buf.write(struct.pack("<QHH", key, typ, n - 1))
+
+        offset = HEADER_BASE_SIZE + len(keys) * (12 + 4)
+        for _, _, _, data in payloads:
+            buf.write(struct.pack("<I", offset))
+            offset += len(data)
+        for _, _, _, data in payloads:
+            buf.write(data)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Bitmap":
+        b = cls()
+        if len(data) < HEADER_BASE_SIZE:
+            raise ValueError("data too small")
+        magic = struct.unpack_from("<H", data, 0)[0]
+        version = struct.unpack_from("<H", data, 2)[0]
+        if magic != MAGIC_NUMBER:
+            raise ValueError(f"invalid roaring file, magic number {magic}")
+        if version != STORAGE_VERSION:
+            raise ValueError(f"wrong roaring version {version}")
+        key_n = struct.unpack_from("<I", data, 4)[0]
+
+        headers = []
+        pos = HEADER_BASE_SIZE
+        for _ in range(key_n):
+            key, typ, n_minus_1 = struct.unpack_from("<QHH", data, pos)
+            headers.append((key, typ, n_minus_1 + 1))
+            pos += 12
+        offsets = struct.unpack_from(f"<{key_n}I", data, pos) if key_n else ()
+        ops_offset = pos + 4 * key_n
+
+        for (key, typ, n), off in zip(headers, offsets):
+            if off >= len(data):
+                raise ValueError(f"offset out of bounds: off={off}, len={len(data)}")
+            if typ == CONTAINER_ARRAY:
+                c = np.frombuffer(data, dtype="<u2", count=n, offset=off).astype(np.uint16)
+                ops_offset = max(ops_offset, off + 2 * n)
+            elif typ == CONTAINER_BITMAP:
+                words = np.frombuffer(data, dtype="<u8", count=BITMAP_N, offset=off)
+                bits = np.unpackbits(
+                    words.view(np.uint8), bitorder="little"
+                )
+                c = np.flatnonzero(bits).astype(np.uint16)
+                ops_offset = max(ops_offset, off + 8 * BITMAP_N)
+            elif typ == CONTAINER_RUN:
+                run_n = struct.unpack_from("<H", data, off)[0]
+                runs = np.frombuffer(
+                    data, dtype="<u2", count=2 * run_n, offset=off + 2
+                ).reshape(run_n, 2)
+                c = (
+                    np.concatenate(
+                        [np.arange(s, l + 1, dtype=np.uint32) for s, l in runs]
+                    ).astype(np.uint16)
+                    if run_n
+                    else _empty()
+                )
+                ops_offset = max(ops_offset, off + 2 + 4 * run_n)
+            else:
+                raise ValueError(f"unknown container type {typ}")
+            if n:
+                b.containers[key] = c
+
+        # Replay trailing op log (reference roaring.go:2889-2953).
+        while ops_offset < len(data):
+            b.apply_op(*parse_op(data, ops_offset))
+            b.op_n += 1
+            ops_offset += OP_SIZE
+        return b
+
+    def apply_op(self, typ: int, value: int) -> bool:
+        if typ == OP_ADD:
+            return self.add(value)
+        if typ == OP_REMOVE:
+            return self.remove(value)
+        raise ValueError(f"invalid op type: {typ}")
+
+    def write_to(self, f) -> int:
+        data = self.to_bytes()
+        f.write(data)
+        return len(data)
+
+
+def encode_op(typ: int, value: int) -> bytes:
+    body = struct.pack("<BQ", typ, value)
+    return body + struct.pack("<I", fnv32a(body))
+
+
+def parse_op(data: bytes, offset: int = 0) -> Tuple[int, int]:
+    if len(data) - offset < OP_SIZE:
+        raise ValueError(f"op data out of bounds: len={len(data) - offset}")
+    typ, value = struct.unpack_from("<BQ", data, offset)
+    chk = struct.unpack_from("<I", data, offset + 9)[0]
+    if chk != fnv32a(data[offset : offset + 9]):
+        raise ValueError("checksum mismatch")
+    return typ, value

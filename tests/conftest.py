@@ -1,0 +1,15 @@
+"""Force tests onto a virtual 8-device CPU platform.
+
+Multi-chip TPU hardware is unavailable in CI; shardings are validated on an
+8-device CPU mesh (the driver separately dry-run-compiles multi-chip via
+__graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
