@@ -1,0 +1,576 @@
+"""Fragment: the compute+storage unit = (index, field, view, shard).
+
+Behavioral port of /root/reference/fragment.go re-architected TPU-first:
+
+- Authoritative cold storage is a host roaring bitmap (storage/bitmap.py) with
+  bit position = rowID*SHARD_WIDTH + columnID%SHARD_WIDTH (fragment.go:1935),
+  persisted in the reference's roaring file format with an appended op-log WAL
+  and snapshot-at-2000-ops semantics (fragment.go:63,167-224,1399-1469).
+- Hot compute state is dense uint32 bitplanes materialized per row on device
+  (HBM) and cached; all set algebra / counts / BSI / TopN math runs there
+  (ops/bitplane.py). Writes invalidate the affected row's plane.
+- TopN keeps the reference's rank/LRU cache design (fragment.go:870-1058) but
+  replaces the per-row IntersectionCount walk with one batched device popcount
+  over a stacked candidate plane tensor — identical results (candidates are
+  count-descending, so the early-exit conditions commute with batching).
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import struct
+from dataclasses import dataclass, field as dataclass_field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import (
+    CACHE_TYPE_NONE,
+    CACHE_TYPE_RANKED,
+    DEFAULT_CACHE_SIZE,
+    HASH_BLOCK_SIZE,
+    MAX_OP_N,
+    SHARD_WIDTH,
+)
+from ..errors import ColumnRowOutOfRangeError
+from ..ops import bitplane as bp
+from ..storage.bitmap import OP_ADD, OP_REMOVE, Bitmap, encode_op
+from .cache import NopCache, Pair, new_cache, sort_pairs
+from .row import Row
+
+import hashlib
+
+# TopN batched intersection-count chunk (rows per device call).
+TOPN_BATCH = 256
+
+
+def _block_hash(positions: np.ndarray) -> bytes:
+    """Checksum of sorted bit positions within a merkle block.
+
+    The reference uses xxhash over (row, col) pairs (fragment.go:1078-1174);
+    we use blake2b-8 — checksums only ever compare against this framework's
+    own, so cross-implementation byte parity is not required.
+    """
+    h = hashlib.blake2b(digest_size=8)
+    h.update(positions.astype("<u8").tobytes())
+    return h.digest()
+
+
+@dataclass
+class FragmentBlock:
+    id: int
+    checksum: bytes
+
+    def to_dict(self):
+        return {"id": self.id, "checksum": self.checksum.hex()}
+
+
+@dataclass
+class TopOptions:
+    """Options for Fragment.top (reference fragment.go topOptions)."""
+
+    n: int = 0
+    src: Optional[Row] = None
+    row_ids: Sequence[int] = ()
+    min_threshold: int = 0
+    filter_name: str = ""
+    filter_values: Sequence = ()
+    tanimoto_threshold: int = 0
+
+
+class Fragment:
+    def __init__(
+        self,
+        path: Optional[str],
+        index: str,
+        field: str,
+        view: str,
+        shard: int,
+        cache_type: str = CACHE_TYPE_RANKED,
+        cache_size: int = DEFAULT_CACHE_SIZE,
+        row_attr_store=None,
+        stats=None,
+        max_op_n: int = MAX_OP_N,
+    ):
+        self.path = path
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.cache_type = cache_type
+        self.cache = new_cache(cache_type, cache_size)
+        self.row_attr_store = row_attr_store
+        self.stats = stats
+        self.max_op_n = max_op_n
+
+        self.storage = Bitmap()
+        self.op_n = 0
+        self._wal = None  # append handle to the storage file
+        self._plane_cache: Dict[int, jnp.ndarray] = {}
+        self._checksums: Dict[int, bytes] = {}
+        self._opened = False
+
+    # ---------------------------------------------------------------- open
+
+    def open(self) -> None:
+        if self.path and os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                data = f.read()
+            if data:
+                self.storage = Bitmap.from_bytes(data)
+                self.op_n = self.storage.op_n
+        if self.path:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            if not os.path.exists(self.path):
+                with open(self.path, "wb") as f:
+                    self.storage.write_to(f)
+            self._wal = open(self.path, "ab")
+        self._load_cache()
+        self._opened = True
+
+    def close(self) -> None:
+        self._flush_cache()
+        if self._wal:
+            self._wal.close()
+            self._wal = None
+        self._opened = False
+
+    # ------------------------------------------------------------ positions
+
+    def pos(self, row_id: int, column_id: int) -> int:
+        min_col = self.shard * SHARD_WIDTH
+        if not (min_col <= column_id < min_col + SHARD_WIDTH):
+            raise ColumnRowOutOfRangeError(
+                f"column {column_id} out of bounds for shard {self.shard}"
+            )
+        return row_id * SHARD_WIDTH + (column_id % SHARD_WIDTH)
+
+    # ----------------------------------------------------------- row planes
+
+    def plane(self, row_id: int) -> jnp.ndarray:
+        """Device bitplane for one row (local column space)."""
+        cached = self._plane_cache.get(row_id)
+        if cached is not None:
+            return cached
+        start = row_id * SHARD_WIDTH
+        local = (self.storage.slice_range(start, start + SHARD_WIDTH) - np.uint64(start)).astype(
+            np.uint32
+        )
+        p = jnp.asarray(bp.pack_bits(local))
+        self._plane_cache[row_id] = p
+        return p
+
+    def plane_stack(self, row_ids: Sequence[int]) -> jnp.ndarray:
+        return jnp.stack([self.plane(r) for r in row_ids])
+
+    def row(self, row_id: int) -> Row:
+        return Row({self.shard: self.plane(row_id)})
+
+    def row_count(self, row_id: int) -> int:
+        start = row_id * SHARD_WIDTH
+        return self.storage.count_range(start, start + SHARD_WIDTH)
+
+    def rows(self) -> List[int]:
+        """Row ids with at least one bit set."""
+        seen = sorted({(key << 16) // SHARD_WIDTH for key in self.storage.containers})
+        return [int(r) for r in seen]
+
+    def bit(self, row_id: int, column_id: int) -> bool:
+        return self.storage.contains(self.pos(row_id, column_id))
+
+    # --------------------------------------------------------------- writes
+
+    def _invalidate_row(self, row_id: int) -> None:
+        self._plane_cache.pop(row_id, None)
+        self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+
+    def set_bit(self, row_id: int, column_id: int) -> bool:
+        pos = self.pos(row_id, column_id)
+        changed = self.storage.add(pos)
+        if not changed:
+            return False
+        self._append_op(OP_ADD, pos)
+        self._invalidate_row(row_id)
+        self.cache.add(row_id, self.row_count(row_id))
+        if self.stats:
+            self.stats.count("setBit", 1)
+        return True
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        pos = self.pos(row_id, column_id)
+        changed = self.storage.remove(pos)
+        if not changed:
+            return False
+        self._append_op(OP_REMOVE, pos)
+        self._invalidate_row(row_id)
+        self.cache.add(row_id, self.row_count(row_id))
+        if self.stats:
+            self.stats.count("clearBit", 1)
+        return True
+
+    def _append_op(self, typ: int, pos: int) -> None:
+        if self._wal:
+            self._wal.write(encode_op(typ, pos))
+            self._wal.flush()
+        self.op_n += 1
+        if self.op_n >= self.max_op_n:
+            self.snapshot()
+
+    # ------------------------------------------------------------------ BSI
+
+    def value(self, column_id: int, bit_depth: int) -> Tuple[int, bool]:
+        """Read a BSI value at a column (reference fragment.go:468-490)."""
+        if not self.bit(bit_depth, column_id):
+            return 0, False
+        value = 0
+        for i in range(bit_depth):
+            if self.bit(i, column_id):
+                value |= 1 << i
+        return value, True
+
+    def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
+        """Write a BSI value bit-by-bit (reference fragment.go:492-520)."""
+        changed = False
+        for i in range(bit_depth):
+            if (value >> i) & 1:
+                changed |= self.set_bit(i, column_id)
+            else:
+                changed |= self.clear_bit(i, column_id)
+        changed |= self.set_bit(bit_depth, column_id)
+        return changed
+
+    def _bsi_planes(self, bit_depth: int) -> jnp.ndarray:
+        return self.plane_stack(list(range(bit_depth + 1)))
+
+    def _filter_plane(self, filter_row: Optional[Row]):
+        if filter_row is None:
+            return None
+        seg = filter_row.segment_plane(self.shard)
+        if seg is None:
+            return jnp.zeros_like(self.plane(0))
+        return seg
+
+    def sum(self, filter_row: Optional[Row], bit_depth: int) -> Tuple[int, int]:
+        """(sum, count) over a BSI group (reference fragment.go:565-600)."""
+        planes = self._bsi_planes(bit_depth)
+        counts = np.asarray(bp.bsi_plane_counts(planes, self._filter_plane(filter_row)))
+        total = sum((1 << i) * int(counts[i]) for i in range(bit_depth))
+        return total, int(counts[bit_depth])
+
+    def min(self, filter_row: Optional[Row], bit_depth: int) -> Tuple[int, int]:
+        planes = self._bsi_planes(bit_depth)
+        bits, count = bp.bsi_min(planes, bit_depth, self._filter_plane(filter_row))
+        count = int(count)
+        if count == 0 and not self._bsi_any(filter_row, bit_depth):
+            return 0, 0
+        return bp.compose_bits(np.asarray(bits)), count
+
+    def max(self, filter_row: Optional[Row], bit_depth: int) -> Tuple[int, int]:
+        planes = self._bsi_planes(bit_depth)
+        bits, count = bp.bsi_max(planes, bit_depth, self._filter_plane(filter_row))
+        count = int(count)
+        if count == 0 and not self._bsi_any(filter_row, bit_depth):
+            return 0, 0
+        return bp.compose_bits(np.asarray(bits)), count
+
+    def _bsi_any(self, filter_row: Optional[Row], bit_depth: int) -> bool:
+        consider = self.plane(bit_depth)
+        fp = self._filter_plane(filter_row)
+        if fp is not None:
+            consider = bp.p_and(consider, fp)
+        return int(bp.count(consider)) > 0
+
+    def range_op(self, op: str, bit_depth: int, predicate: int) -> Row:
+        """op in {eq,neq,lt,lte,gt,gte} (reference fragment.go:660-681)."""
+        planes = self._bsi_planes(bit_depth)
+        if op == "eq":
+            plane = bp.bsi_range_eq(planes, bit_depth, predicate)
+        elif op == "neq":
+            plane = bp.bsi_range_neq(planes, bit_depth, predicate)
+        elif op in ("lt", "lte"):
+            plane = bp.bsi_range_lt(planes, bit_depth, predicate, op == "lte")
+        elif op in ("gt", "gte"):
+            plane = bp.bsi_range_gt(planes, bit_depth, predicate, op == "gte")
+        else:
+            raise ValueError(f"invalid range operation: {op}")
+        return Row({self.shard: plane})
+
+    def range_between(self, bit_depth: int, pmin: int, pmax: int) -> Row:
+        planes = self._bsi_planes(bit_depth)
+        return Row({self.shard: bp.bsi_range_between(planes, bit_depth, pmin, pmax)})
+
+    def not_null(self, bit_depth: int) -> Row:
+        return self.row(bit_depth)
+
+    # ----------------------------------------------------------------- TopN
+
+    def top(self, opt: TopOptions) -> List[Pair]:
+        pairs = self._top_pairs(list(opt.row_ids))
+        n = 0 if opt.row_ids else opt.n
+
+        filters = set(opt.filter_values) if opt.filter_name and opt.filter_values else None
+
+        tanimoto = 0
+        min_tan = max_tan = 0.0
+        src_count = 0
+        if opt.tanimoto_threshold > 0 and opt.src is not None:
+            tanimoto = opt.tanimoto_threshold
+            src_count = opt.src.count()
+            min_tan = src_count * tanimoto / 100.0
+            max_tan = src_count * 100.0 / tanimoto
+
+        # Pre-filter candidates (cheap host checks), then batch-count the
+        # survivors' intersections with src on device.
+        candidates: List[Tuple[int, int]] = []  # (row_id, cnt)
+        for p in pairs:
+            row_id, cnt = p.id, p.count
+            if cnt <= 0:
+                continue
+            if tanimoto > 0:
+                if cnt <= min_tan or cnt >= max_tan:
+                    continue
+            elif cnt < opt.min_threshold:
+                continue
+            if filters is not None:
+                attrs = (
+                    self.row_attr_store.attrs(row_id) if self.row_attr_store else None
+                )
+                if not attrs:
+                    continue
+                if attrs.get(opt.filter_name) not in filters:
+                    continue
+            candidates.append((row_id, cnt))
+
+        inter: Dict[int, int] = {}
+        if opt.src is not None and candidates:
+            src_plane = self._filter_plane(opt.src)
+            for i in range(0, len(candidates), TOPN_BATCH):
+                chunk = candidates[i : i + TOPN_BATCH]
+                planes = self.plane_stack([r for r, _ in chunk])
+                counts = np.asarray(bp.topn_counts(planes, src_plane))
+                for (row_id, _), c in zip(chunk, counts):
+                    inter[row_id] = int(c)
+
+        # Replay the reference's heap selection on host ints
+        # (fragment.go:899-990) — exact semantics incl. threshold early-exit.
+        results: List[Tuple[int, int]] = []  # min-heap of (count, row_id)
+        out: List[Pair] = []
+        for row_id, cnt in candidates:
+            if n == 0 or len(results) < n:
+                count = inter[row_id] if opt.src is not None else cnt
+                if count == 0:
+                    continue
+                if tanimoto > 0:
+                    import math
+
+                    tan = math.ceil(count * 100.0 / (cnt + src_count - count))
+                    if tan <= tanimoto:
+                        continue
+                elif count < opt.min_threshold:
+                    continue
+                heapq.heappush(results, (count, row_id))
+                if n > 0 and len(results) == n and opt.src is None:
+                    break
+                continue
+
+            threshold = results[0][0]
+            if threshold < opt.min_threshold or cnt < threshold:
+                break
+            count = inter[row_id] if opt.src is not None else cnt
+            if count < threshold:
+                continue
+            heapq.heappush(results, (count, row_id))
+
+        out = sort_pairs([Pair(id=r, count=c) for c, r in results])
+        return out
+
+    def _top_pairs(self, row_ids: List[int]) -> List[Pair]:
+        if self.cache_type == CACHE_TYPE_NONE and not row_ids:
+            return []
+        if not row_ids:
+            self.cache.invalidate()
+            return self.cache.top()
+        pairs = []
+        for row_id in row_ids:
+            cnt = self.cache.get(row_id)
+            if cnt <= 0:
+                cnt = self.row_count(row_id)
+            if cnt > 0:
+                pairs.append(Pair(id=row_id, count=cnt))
+        return sort_pairs(pairs)
+
+    # --------------------------------------------------------------- blocks
+
+    def blocks(self) -> List[FragmentBlock]:
+        """Merkle block checksums of HASH_BLOCK_SIZE-row groups."""
+        vals = self.storage.slice()
+        if len(vals) == 0:
+            return []
+        block_width = HASH_BLOCK_SIZE * SHARD_WIDTH
+        block_ids = (vals // np.uint64(block_width)).astype(np.int64)
+        out = []
+        for bid in np.unique(block_ids):
+            bid = int(bid)
+            cached = self._checksums.get(bid)
+            if cached is None:
+                cached = _block_hash(vals[block_ids == bid])
+                self._checksums[bid] = cached
+            out.append(FragmentBlock(id=bid, checksum=cached))
+        return out
+
+    def checksum(self) -> bytes:
+        h = hashlib.blake2b(digest_size=16)
+        for block in self.blocks():
+            h.update(block.checksum)
+        return h.digest()
+
+    def invalidate_checksums(self) -> None:
+        self._checksums.clear()
+
+    def block_data(self, block_id: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(rowIDs, columnIDs) of bits in a block (reference fragment.go:1160)."""
+        block_width = HASH_BLOCK_SIZE * SHARD_WIDTH
+        vals = self.storage.slice_range(
+            block_id * block_width, (block_id + 1) * block_width
+        )
+        return vals // np.uint64(SHARD_WIDTH), vals % np.uint64(SHARD_WIDTH)
+
+    def merge_block(
+        self, block_id: int, data: List[Tuple[np.ndarray, np.ndarray]]
+    ) -> Tuple[List[List[Tuple[int, int]]], List[List[Tuple[int, int]]]]:
+        """Consensus-merge a block across replicas (fragment.go:1176-1293).
+
+        data: per-replica (rowIDs, columnIDs) pair sets, local block NOT
+        included. Returns (sets, clears) diffs per input replica, majority
+        vote over {local} ∪ replicas, and applies the local diff.
+        """
+        local_rows, local_cols = self.block_data(block_id)
+        all_sets = [set(zip(local_rows.tolist(), local_cols.tolist()))]
+        for rows, cols in data:
+            all_sets.append(set(zip(np.asarray(rows).tolist(), np.asarray(cols).tolist())))
+        n_voters = len(all_sets)
+        votes: Dict[Tuple[int, int], int] = {}
+        for s in all_sets:
+            for pair in s:
+                votes[pair] = votes.get(pair, 0) + 1
+        consensus = {p for p, v in votes.items() if v * 2 > n_voters}
+
+        sets_out, clears_out = [], []
+        for i, s in enumerate(all_sets):
+            add = sorted(consensus - s)
+            rem = sorted(s - consensus)
+            if i == 0:
+                for r, c in add:
+                    self.set_bit(int(r), int(self.shard * SHARD_WIDTH + c))
+                for r, c in rem:
+                    self.clear_bit(int(r), int(self.shard * SHARD_WIDTH + c))
+            else:
+                sets_out.append(add)
+                clears_out.append(rem)
+        return sets_out, clears_out
+
+    # --------------------------------------------------------------- import
+
+    def bulk_import(self, row_ids: np.ndarray, column_ids: np.ndarray) -> None:
+        """Set many bits at once, then snapshot (reference fragment.go:1298)."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        positions = row_ids * np.uint64(SHARD_WIDTH) + (
+            column_ids % np.uint64(SHARD_WIDTH)
+        )
+        self.storage.add_many(positions)
+        for row_id in np.unique(row_ids):
+            self._invalidate_row(int(row_id))
+            self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
+        self.cache.invalidate(force=True)
+        self.snapshot()
+
+    def import_value(
+        self, column_ids: np.ndarray, values: np.ndarray, bit_depth: int
+    ) -> None:
+        """Bulk BSI import (reference fragment.go:1361-1397)."""
+        column_ids = np.asarray(column_ids, dtype=np.uint64) % np.uint64(SHARD_WIDTH)
+        values = np.asarray(values, dtype=np.uint64)
+        for i in range(bit_depth):
+            mask = (values >> np.uint64(i)) & np.uint64(1)
+            on = column_ids[mask == 1]
+            off = column_ids[mask == 0]
+            base = np.uint64(i * SHARD_WIDTH)
+            self.storage.add_many(on + base)
+            self.storage.remove_many(off + base)
+            self._invalidate_row(i)
+        self.storage.add_many(column_ids + np.uint64(bit_depth * SHARD_WIDTH))
+        self._invalidate_row(bit_depth)
+        self.snapshot()
+
+    # ---------------------------------------------------------- persistence
+
+    def snapshot(self) -> None:
+        """Rewrite the storage file without the op log (fragment.go:1399-1469)."""
+        if not self.path:
+            self.op_n = 0
+            return
+        if self._wal:
+            self._wal.close()
+            self._wal = None
+        tmp = self.path + ".snapshotting"
+        with open(tmp, "wb") as f:
+            self.storage.write_to(f)
+        os.replace(tmp, self.path)
+        self.op_n = 0
+        self._wal = open(self.path, "ab")
+        if self.stats:
+            self.stats.count("snapshot", 1)
+
+    def cache_path(self) -> Optional[str]:
+        return self.path + ".cache" if self.path else None
+
+    def _flush_cache(self) -> None:
+        """Persist TopN cache row ids (reference fragment.go:1478-1509)."""
+        path = self.cache_path()
+        if not path or isinstance(self.cache, NopCache):
+            return
+        ids = self.cache.ids()
+        with open(path, "wb") as f:
+            f.write(struct.pack("<I", len(ids)))
+            f.write(np.asarray(ids, dtype="<u8").tobytes())
+
+    def _load_cache(self) -> None:
+        path = self.cache_path()
+        if not path or not os.path.exists(path) or isinstance(self.cache, NopCache):
+            return
+        with open(path, "rb") as f:
+            data = f.read()
+        if len(data) < 4:
+            return
+        (n,) = struct.unpack_from("<I", data, 0)
+        ids = np.frombuffer(data, dtype="<u8", count=n, offset=4)
+        for row_id in ids:
+            self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
+        self.cache.invalidate(force=True)
+
+    def flush_cache(self) -> None:
+        self._flush_cache()
+
+    # ----------------------------------------------------------- shard ship
+
+    def write_to(self, f) -> None:
+        """Serialize fragment data for shard shipping (fragment.go:1511-1683)."""
+        data = self.storage.to_bytes()
+        f.write(struct.pack("<Q", len(data)))
+        f.write(data)
+
+    def read_from(self, f) -> None:
+        (n,) = struct.unpack("<Q", f.read(8))
+        self.storage = Bitmap.from_bytes(f.read(n))
+        self.op_n = 0
+        self._plane_cache.clear()
+        self._checksums.clear()
+        self.cache.clear()
+        for row_id in self.rows():
+            self.cache.bulk_add(row_id, self.row_count(row_id))
+        self.cache.invalidate(force=True)
+        if self.path:
+            self.snapshot()

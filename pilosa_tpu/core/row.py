@@ -1,0 +1,135 @@
+"""Query-result rows spanning shards.
+
+A Row is the framework's equivalent of the reference's Row/RowSegment
+(/root/reference/row.go:27,312): per-shard *device bitplanes* keyed by shard
+number. Set algebra merges segment maps shard-by-shard with bitplane kernels;
+column ids only materialize on host at the API edge (columns()), mirroring how
+the reference never concatenates segments.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import SHARD_WIDTH, WORDS_PER_ROW
+from ..ops import bitplane as bp
+
+
+def _zero_plane():
+    return jnp.zeros((WORDS_PER_ROW,), dtype=jnp.uint32)
+
+
+class Row:
+    __slots__ = ("segments", "attrs", "keys")
+
+    def __init__(self, segments: Optional[Dict[int, jnp.ndarray]] = None, columns=None):
+        self.segments: Dict[int, jnp.ndarray] = dict(segments or {})
+        self.attrs: dict = {}
+        self.keys: List[str] = []
+        if columns is not None:
+            self._add_columns(columns)
+
+    def _add_columns(self, columns: Iterable[int]) -> None:
+        cols = np.asarray(sorted(columns), dtype=np.uint64)
+        if len(cols) == 0:
+            return
+        shards = (cols // SHARD_WIDTH).astype(np.int64)
+        for shard in np.unique(shards):
+            local = (cols[shards == shard] % SHARD_WIDTH).astype(np.uint32)
+            packed = bp.pack_bits(local)
+            existing = self.segments.get(int(shard))
+            plane = jnp.asarray(packed)
+            if existing is not None:
+                plane = jnp.bitwise_or(existing, plane)
+            self.segments[int(shard)] = plane
+
+    # -------------------------------------------------------------- algebra
+
+    def union(self, *others: "Row") -> "Row":
+        out = dict(self.segments)
+        for other in others:
+            for shard, seg in other.segments.items():
+                cur = out.get(shard)
+                out[shard] = seg if cur is None else bp.p_or(cur, seg)
+        return Row(out)
+
+    def intersect(self, *others: "Row") -> "Row":
+        out = dict(self.segments)
+        for other in others:
+            nxt = {}
+            for shard, seg in out.items():
+                o = other.segments.get(shard)
+                if o is not None:
+                    nxt[shard] = bp.p_and(seg, o)
+            out = nxt
+        return Row(out)
+
+    def difference(self, *others: "Row") -> "Row":
+        out = dict(self.segments)
+        for other in others:
+            for shard, seg in other.segments.items():
+                cur = out.get(shard)
+                if cur is not None:
+                    out[shard] = bp.p_andnot(cur, seg)
+        return Row(out)
+
+    def xor(self, *others: "Row") -> "Row":
+        out = dict(self.segments)
+        for other in others:
+            for shard, seg in other.segments.items():
+                cur = out.get(shard)
+                out[shard] = seg if cur is None else bp.p_xor(cur, seg)
+        return Row(out)
+
+    def intersection_count(self, other: "Row") -> int:
+        n = 0
+        for shard, seg in self.segments.items():
+            o = other.segments.get(shard)
+            if o is not None:
+                n += int(bp.and_count(seg, o))
+        return n
+
+    def merge(self, other: "Row") -> None:
+        """In-place union (the reference's Row.Merge reduce step, row.go:47)."""
+        for shard, seg in other.segments.items():
+            cur = self.segments.get(shard)
+            self.segments[shard] = seg if cur is None else bp.p_or(cur, seg)
+
+    # ------------------------------------------------------------- material
+
+    def count(self) -> int:
+        return sum(int(bp.count(seg)) for seg in self.segments.values())
+
+    def any(self) -> bool:
+        return any(int(bp.count(seg)) > 0 for seg in self.segments.values())
+
+    def columns(self) -> np.ndarray:
+        """Ascending absolute column ids (uint64) — host materialization."""
+        parts = []
+        for shard in sorted(self.segments):
+            cols = bp.unpack_bits(np.asarray(self.segments[shard]))
+            if len(cols):
+                parts.append(cols + np.uint64(shard * SHARD_WIDTH))
+        if not parts:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(parts)
+
+    def segment_plane(self, shard: int):
+        return self.segments.get(shard)
+
+    def shard_row(self, shard: int) -> "Row":
+        seg = self.segments.get(shard)
+        return Row({shard: seg} if seg is not None else {})
+
+    def __eq__(self, other):
+        if not isinstance(other, Row):
+            return NotImplemented
+        return np.array_equal(self.columns(), other.columns())
+
+    def __repr__(self):
+        cols = self.columns()
+        preview = cols[:10].tolist()
+        return f"Row(n={len(cols)}, cols={preview}{'...' if len(cols) > 10 else ''})"

@@ -1,0 +1,254 @@
+"""Fragment tests (model: /root/reference/fragment_internal_test.go).
+
+Covers setBit/clearBit, BSI value/sum/min/max/range, TopN (cache sizes,
+src-intersection, tanimoto), merkle blocks, WAL + snapshot durability across
+reopen, bulk import, and cache persistence.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.constants import CACHE_TYPE_RANKED, SHARD_WIDTH
+from pilosa_tpu.core.fragment import Fragment, TopOptions
+from pilosa_tpu.core.row import Row
+
+
+def make_fragment(tmp_path=None, shard=0, **kw):
+    path = str(tmp_path / f"frag.{shard}") if tmp_path else None
+    f = Fragment(path, "i", "f", "standard", shard, **kw)
+    f.open()
+    return f
+
+
+def test_set_clear_bit(tmp_path):
+    f = make_fragment(tmp_path)
+    assert f.set_bit(120, 1)
+    assert f.set_bit(120, 6)
+    assert f.set_bit(121, 0)
+    assert not f.set_bit(120, 6)  # already set
+    assert list(f.row(120).columns()) == [1, 6]
+    assert f.row_count(120) == 2
+    assert f.clear_bit(120, 1)
+    assert not f.clear_bit(120, 1)
+    assert list(f.row(120).columns()) == [6]
+
+
+def test_shard_offset_columns(tmp_path):
+    f = make_fragment(tmp_path, shard=2)
+    base = 2 * SHARD_WIDTH
+    assert f.set_bit(7, base + 5)
+    assert list(f.row(7).columns()) == [base + 5]
+    with pytest.raises(Exception):
+        f.set_bit(7, 5)  # column outside shard
+
+
+def test_wal_and_snapshot_durability(tmp_path):
+    f = make_fragment(tmp_path, max_op_n=5)
+    for i in range(12):  # crosses snapshot threshold twice
+        f.set_bit(1, i)
+    f.close()
+    f2 = make_fragment(tmp_path)
+    assert list(f2.row(1).columns()) == list(range(12))
+
+
+def test_wal_replay_without_snapshot(tmp_path):
+    f = make_fragment(tmp_path, max_op_n=10_000)
+    f.set_bit(3, 42)
+    f.clear_bit(3, 42)
+    f.set_bit(3, 43)
+    f.close()
+    f2 = make_fragment(tmp_path)
+    assert list(f2.row(3).columns()) == [43]
+
+
+def test_bsi_value_roundtrip(tmp_path):
+    f = make_fragment(tmp_path)
+    assert f.set_value(100, 8, 177)
+    value, exists = f.value(100, 8)
+    assert (value, exists) == (177, True)
+    _, exists = f.value(101, 8)
+    assert not exists
+    # Overwrite.
+    f.set_value(100, 8, 23)
+    assert f.value(100, 8) == (23, True)
+
+
+def test_bsi_sum_min_max(tmp_path):
+    f = make_fragment(tmp_path)
+    vals = {10: 7, 20: 100, 30: 100, 40: 3}
+    for col, v in vals.items():
+        f.set_value(col, 8, v)
+    assert f.sum(None, 8) == (210, 4)
+    assert f.min(None, 8) == (3, 1)
+    assert f.max(None, 8) == (100, 2)
+    filt = Row(columns=[10, 20])
+    assert f.sum(filt, 8) == (107, 2)
+    assert f.min(filt, 8) == (7, 1)
+    assert f.max(filt, 8) == (100, 1)
+
+
+def test_bsi_range(tmp_path):
+    f = make_fragment(tmp_path)
+    vals = {1: 10, 2: 20, 3: 30, 4: 40}
+    for col, v in vals.items():
+        f.set_value(col, 8, v)
+    assert list(f.range_op("eq", 8, 20).columns()) == [2]
+    assert list(f.range_op("neq", 8, 20).columns()) == [1, 3, 4]
+    assert list(f.range_op("lt", 8, 30).columns()) == [1, 2]
+    assert list(f.range_op("lte", 8, 30).columns()) == [1, 2, 3]
+    assert list(f.range_op("gt", 8, 20).columns()) == [3, 4]
+    assert list(f.range_op("gte", 8, 20).columns()) == [2, 3, 4]
+    assert list(f.range_between(8, 15, 35).columns()) == [2, 3]
+    assert list(f.not_null(8).columns()) == [1, 2, 3, 4]
+
+
+def test_top_basic(tmp_path):
+    f = make_fragment(tmp_path)
+    for col in range(5):
+        f.set_bit(100, col)
+    for col in range(3):
+        f.set_bit(101, col)
+    f.set_bit(102, 0)
+    pairs = f.top(TopOptions(n=2))
+    assert [(p.id, p.count) for p in pairs] == [(100, 5), (101, 3)]
+    # All rows when n=0.
+    pairs = f.top(TopOptions(n=0))
+    assert [(p.id, p.count) for p in pairs] == [(100, 5), (101, 3), (102, 1)]
+
+
+def test_top_with_src(tmp_path):
+    f = make_fragment(tmp_path)
+    for col in range(10):
+        f.set_bit(100, col)
+    for col in range(4, 12):
+        f.set_bit(101, col)
+    for col in range(8, 9):
+        f.set_bit(102, col)
+    src = Row(columns=list(range(5, 20)))
+    pairs = f.top(TopOptions(n=2, src=src))
+    # row 101 ∩ src = {5..11} = 7; row 100 ∩ src = {5..9} = 5; row 102 = 1
+    assert [(p.id, p.count) for p in pairs] == [(101, 7), (100, 5)]
+
+
+def test_top_row_ids(tmp_path):
+    f = make_fragment(tmp_path)
+    for col in range(5):
+        f.set_bit(100, col)
+    for col in range(3):
+        f.set_bit(101, col)
+    f.set_bit(102, 9)
+    pairs = f.top(TopOptions(n=1, row_ids=[101, 102]))
+    # Explicit row ids disable truncation (reference fragment.go:873-876).
+    assert [(p.id, p.count) for p in pairs] == [(101, 3), (102, 1)]
+
+
+def test_top_min_threshold(tmp_path):
+    f = make_fragment(tmp_path)
+    for col in range(5):
+        f.set_bit(100, col)
+    for col in range(3):
+        f.set_bit(101, col)
+    f.set_bit(102, 0)
+    pairs = f.top(TopOptions(n=10, min_threshold=3))
+    assert [(p.id, p.count) for p in pairs] == [(100, 5), (101, 3)]
+
+
+def test_top_tanimoto(tmp_path):
+    f = make_fragment(tmp_path)
+    # src = {0..9}; row 100 = {0..9} (tanimoto 100), row 101 = {0..4,20..24}
+    # (intersection 5, union 15 → ceil(5*100/15)=34), row 102 = {50} (0).
+    for col in range(10):
+        f.set_bit(100, col)
+    for col in list(range(5)) + list(range(20, 25)):
+        f.set_bit(101, col)
+    f.set_bit(102, 50)
+    src = Row(columns=list(range(10)))
+    pairs = f.top(TopOptions(src=src, tanimoto_threshold=50))
+    assert [(p.id, p.count) for p in pairs] == [(100, 10)]
+    pairs = f.top(TopOptions(src=src, tanimoto_threshold=30))
+    assert [(p.id, p.count) for p in pairs] == [(100, 10), (101, 5)]
+
+
+def test_top_attr_filter(tmp_path):
+    class AttrStore:
+        def attrs(self, row_id):
+            return {"x": row_id % 2}
+
+    f = make_fragment(tmp_path, row_attr_store=AttrStore())
+    for col in range(5):
+        f.set_bit(100, col)
+    for col in range(3):
+        f.set_bit(101, col)
+    pairs = f.top(TopOptions(n=10, filter_name="x", filter_values=[1]))
+    assert [(p.id, p.count) for p in pairs] == [(101, 3)]
+
+
+def test_blocks_change_on_write(tmp_path):
+    f = make_fragment(tmp_path)
+    f.set_bit(0, 1)
+    b1 = f.blocks()
+    assert [b.id for b in b1] == [0]
+    f.set_bit(0, 2)
+    b2 = f.blocks()
+    assert b1[0].checksum != b2[0].checksum
+    f.set_bit(250, 1)  # block 2
+    assert [b.id for b in f.blocks()] == [0, 2]
+
+
+def test_merge_block_consensus(tmp_path):
+    f = make_fragment(tmp_path)
+    f.set_bit(0, 1)  # local has (0,1)
+    # Two replicas both have (0,2) and neither has (0,1): consensus = {(0,2)}.
+    replica = (np.array([0]), np.array([2]))
+    sets, clears = f.merge_block(0, [replica, replica])
+    assert list(f.row(0).columns()) == [2]
+    assert sets == [[], []] and clears == [[], []]
+
+
+def test_bulk_import(tmp_path):
+    f = make_fragment(tmp_path)
+    rows = np.array([1, 1, 2, 2, 2])
+    cols = np.array([10, 20, 10, 30, 40])
+    f.bulk_import(rows, cols)
+    assert list(f.row(1).columns()) == [10, 20]
+    assert list(f.row(2).columns()) == [10, 30, 40]
+    pairs = f.top(TopOptions(n=2))
+    assert [(p.id, p.count) for p in pairs] == [(2, 3), (1, 2)]
+
+
+def test_import_value(tmp_path):
+    f = make_fragment(tmp_path)
+    cols = np.array([5, 6, 7])
+    vals = np.array([100, 0, 255])
+    f.import_value(cols, vals, 8)
+    assert f.value(5, 8) == (100, True)
+    assert f.value(6, 8) == (0, True)
+    assert f.value(7, 8) == (255, True)
+    assert f.sum(None, 8) == (355, 3)
+
+
+def test_cache_persistence(tmp_path):
+    f = make_fragment(tmp_path, cache_type=CACHE_TYPE_RANKED)
+    for col in range(5):
+        f.set_bit(7, col)
+    f.close()
+    f2 = make_fragment(tmp_path)
+    assert f2.cache.get(7) == 5
+
+
+def test_write_read_roundtrip(tmp_path):
+    f = make_fragment(tmp_path)
+    f.set_bit(1, 10)
+    f.set_bit(2, 20)
+    import io
+
+    buf = io.BytesIO()
+    f.write_to(buf)
+    buf.seek(0)
+    g = make_fragment(tmp_path / "other" if False else None)
+    g = Fragment(None, "i", "f", "standard", 0)
+    g.open()
+    g.read_from(buf)
+    assert list(g.row(1).columns()) == [10]
+    assert list(g.row(2).columns()) == [20]
+    assert g.cache.get(1) == 1
