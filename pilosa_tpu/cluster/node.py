@@ -1,0 +1,108 @@
+"""Node identity and cluster membership/placement.
+
+Port of the data-placement core of /root/reference/cluster.go: Node, cluster
+states, partition/shardNodes placement with replication. The full resize
+state machine lives in cluster/resize.py; this module is dependency-light so
+the executor can use placement without pulling in networking.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..constants import DEFAULT_PARTITION_N
+from .hash import JmpHasher, partition as partition_of
+
+# Cluster states (reference cluster.go:43-45).
+STATE_STARTING = "STARTING"
+STATE_NORMAL = "NORMAL"
+STATE_RESIZING = "RESIZING"
+
+
+@dataclass
+class Node:
+    id: str
+    uri: str = ""
+    is_coordinator: bool = False
+
+    def to_dict(self):
+        return {"id": self.id, "uri": self.uri, "isCoordinator": self.is_coordinator}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(id=d["id"], uri=d.get("uri", ""), is_coordinator=d.get("isCoordinator", False))
+
+
+class Cluster:
+    """Membership + placement. Single-node by default; multi-node clusters
+    append Nodes (sorted by id, as the reference maintains them)."""
+
+    def __init__(
+        self,
+        node: Optional[Node] = None,
+        nodes: Optional[List[Node]] = None,
+        replica_n: int = 1,
+        partition_n: int = DEFAULT_PARTITION_N,
+        hasher=None,
+    ):
+        self.node = node or Node(id="node0")
+        self.nodes: List[Node] = nodes or [self.node]
+        self.replica_n = replica_n
+        self.partition_n = partition_n
+        self.hasher = hasher or JmpHasher()
+        self.state = STATE_NORMAL
+
+    # ------------------------------------------------------------ placement
+
+    def partition(self, index: str, shard: int) -> int:
+        return partition_of(index, shard, self.partition_n)
+
+    def partition_nodes(self, partition_id: int) -> List[Node]:
+        if not self.nodes:
+            return []
+        replica_n = min(self.replica_n, len(self.nodes)) or 1
+        node_index = self.hasher.hash(partition_id, len(self.nodes))
+        return [
+            self.nodes[(node_index + i) % len(self.nodes)] for i in range(replica_n)
+        ]
+
+    def shard_nodes(self, index: str, shard: int) -> List[Node]:
+        return self.partition_nodes(self.partition(index, shard))
+
+    def owns_shard(self, node_id: str, index: str, shard: int) -> bool:
+        return any(n.id == node_id for n in self.shard_nodes(index, shard))
+
+    def contains_shards(self, index: str, max_shard: int, node: Node) -> List[int]:
+        return [
+            s
+            for s in range(max_shard + 1)
+            if any(n.id == node.id for n in self.partition_nodes(self.partition(index, s)))
+        ]
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        for n in self.nodes:
+            if n.id == node_id:
+                return n
+        return None
+
+    def coordinator_node(self) -> Optional[Node]:
+        for n in self.nodes:
+            if n.is_coordinator:
+                return n
+        return None
+
+    def is_coordinator(self) -> bool:
+        return self.node.is_coordinator
+
+    def add_node(self, node: Node) -> None:
+        if self.node_by_id(node.id) is None:
+            self.nodes.append(node)
+            self.nodes.sort(key=lambda n: n.id)
+
+    def remove_node(self, node_id: str) -> bool:
+        n = self.node_by_id(node_id)
+        if n is None:
+            return False
+        self.nodes.remove(n)
+        return True

@@ -1,0 +1,137 @@
+"""Holder: root container of all indexes (port of /root/reference/holder.go).
+
+Opens by scanning the data directory tree (index -> field -> view ->
+fragment), exposes schema encode/apply for cluster sync, and provides the
+fragment lookup used throughout the executor.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Dict, List, Optional
+
+from ..errors import IndexExistsError, IndexNotFoundError
+from .field import Field, FieldOptions
+from .fragment import Fragment
+from .index import Index, IndexOptions
+
+
+class Holder:
+    def __init__(self, path: Optional[str] = None, stats=None, broadcast_shard=None):
+        self.path = path
+        self.stats = stats
+        self.broadcast_shard = broadcast_shard
+        self.indexes: Dict[str, Index] = {}
+        self._lock = threading.RLock()
+        self.opened = False
+
+    def open(self) -> "Holder":
+        if self.path:
+            os.makedirs(self.path, exist_ok=True)
+            for name in sorted(os.listdir(self.path)):
+                ipath = os.path.join(self.path, name)
+                if not os.path.isdir(ipath) or name.startswith("."):
+                    continue
+                index = Index(
+                    ipath, name, stats=self.stats, broadcast_shard=self.broadcast_shard
+                )
+                index.open()
+                self.indexes[name] = index
+        self.opened = True
+        return self
+
+    def close(self) -> None:
+        for index in self.indexes.values():
+            index.close()
+        self.opened = False
+
+    def reopen(self) -> "Holder":
+        """Close and reopen from disk (test helper, reference test/holder.go:62)."""
+        self.close()
+        self.indexes = {}
+        return self.open()
+
+    # -------------------------------------------------------------- indexes
+
+    def index(self, name: str) -> Optional[Index]:
+        return self.indexes.get(name)
+
+    def create_index(self, name: str, options: Optional[IndexOptions] = None) -> Index:
+        with self._lock:
+            if name in self.indexes:
+                raise IndexExistsError(name)
+            return self._create_index(name, options or IndexOptions())
+
+    def create_index_if_not_exists(self, name: str, options: Optional[IndexOptions] = None) -> Index:
+        with self._lock:
+            if name in self.indexes:
+                return self.indexes[name]
+            return self._create_index(name, options or IndexOptions())
+
+    def _create_index(self, name: str, options: IndexOptions) -> Index:
+        index = Index(
+            os.path.join(self.path, name) if self.path else None,
+            name,
+            options=options,
+            stats=self.stats,
+            broadcast_shard=self.broadcast_shard,
+        )
+        index.open()
+        index.save_meta()
+        self.indexes[name] = index
+        return index
+
+    def delete_index(self, name: str) -> None:
+        with self._lock:
+            index = self.indexes.pop(name, None)
+            if index is None:
+                raise IndexNotFoundError(name)
+            index.close()
+            if index.path and os.path.isdir(index.path):
+                shutil.rmtree(index.path)
+
+    def index_names(self) -> List[str]:
+        return sorted(self.indexes)
+
+    # ------------------------------------------------------------ fragments
+
+    def field(self, index: str, name: str) -> Optional[Field]:
+        idx = self.index(index)
+        return idx.field(name) if idx else None
+
+    def fragment(self, index: str, field: str, view: str, shard: int) -> Optional[Fragment]:
+        f = self.field(index, field)
+        if f is None:
+            return None
+        v = f.view(view)
+        if v is None:
+            return None
+        return v.fragment(shard)
+
+    # --------------------------------------------------------------- schema
+
+    def schema(self) -> List[dict]:
+        """Encode schema for cluster sync (reference holder.go:213-273)."""
+        return [idx.to_info() for _, idx in sorted(self.indexes.items())]
+
+    def apply_schema(self, schema: List[dict]) -> None:
+        for idx_info in schema:
+            index = self.create_index_if_not_exists(
+                idx_info["name"], IndexOptions.from_dict(idx_info.get("options", {}))
+            )
+            for f_info in idx_info.get("fields", []):
+                field = index.create_field_if_not_exists(
+                    f_info["name"], FieldOptions.from_dict(f_info.get("options", {}))
+                )
+                for v_info in f_info.get("views", []):
+                    field.create_view_if_not_exists(v_info["name"])
+
+    def flush_caches(self) -> None:
+        """Persist all TopN caches (reference holder.go:425-461)."""
+        for index in self.indexes.values():
+            for field in index.fields.values():
+                for view in field.views.values():
+                    for frag in view.fragments.values():
+                        frag.flush_cache()

@@ -1,0 +1,605 @@
+"""PQL executor: recursive evaluator + distributed map/reduce.
+
+Port of /root/reference/executor.go. Per-shard bitmap math runs on device
+bitplanes (ops/bitplane.py via core/fragment.py); this module owns call
+dispatch, the shard map/reduce (executor.go:1464-1593), two-phase TopN
+(executor.go:524-560), writes, and string-key translation
+(executor.go:1595-1699).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field as dc_field
+from datetime import datetime
+from typing import Any, Dict, List, Optional, Sequence
+
+from .constants import MAX_WRITES_PER_REQUEST, SHARD_WIDTH, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
+from .core.cache import Pair, add_pairs, sort_pairs
+from .core.fragment import TopOptions
+from .core.holder import Holder
+from .core.row import Row
+from .errors import (
+    FieldNotFoundError,
+    BSIGroupNotFoundError,
+    IndexNotFoundError,
+    PilosaError,
+    QueryError,
+    TooManyWritesError,
+)
+from .pql import parser as pql_parser
+from .pql.ast import BETWEEN, Call, Condition, GT, GTE, LT, LTE, NEQ, Query
+from .timeq import parse_timestamp, views_by_time_range
+
+DEFAULT_FIELD = "general"
+DEFAULT_MIN_THRESHOLD = 1
+
+_WRITE_CALLS = {"Set", "Clear", "SetValue", "SetRowAttrs", "SetColumnAttrs"}
+
+
+@dataclass
+class ExecOptions:
+    remote: bool = False
+    exclude_row_attrs: bool = False
+    exclude_columns: bool = False
+    column_attrs: bool = False
+
+
+@dataclass
+class ValCount:
+    """Sum/Min/Max result (reference executor.go:1762-1808)."""
+
+    val: int = 0
+    count: int = 0
+
+    def add(self, other: "ValCount") -> "ValCount":
+        return ValCount(self.val + other.val, self.count + other.count)
+
+    def smaller(self, other: "ValCount") -> "ValCount":
+        if self.count == 0 or (other.val < self.val and other.count > 0):
+            return other
+        return ValCount(self.val, self.count)
+
+    def larger(self, other: "ValCount") -> "ValCount":
+        if self.count == 0 or (other.val > self.val and other.count > 0):
+            return other
+        return ValCount(self.val, self.count)
+
+    def to_dict(self):
+        return {"value": self.val, "count": self.count}
+
+
+class Executor:
+    def __init__(
+        self,
+        holder: Holder,
+        cluster=None,
+        client=None,
+        translate_store=None,
+        max_writes_per_request: int = MAX_WRITES_PER_REQUEST,
+        workers: int = 8,
+    ):
+        from .cluster.node import Cluster
+
+        self.holder = holder
+        self.cluster = cluster or Cluster()
+        self.client = client
+        self.translate_store = translate_store
+        self.max_writes_per_request = max_writes_per_request
+        self._pool = ThreadPoolExecutor(max_workers=workers) if workers > 1 else None
+
+    @property
+    def node(self):
+        return self.cluster.node
+
+    # ------------------------------------------------------------- execute
+
+    def execute(
+        self,
+        index: str,
+        query,
+        shards: Optional[Sequence[int]] = None,
+        opt: Optional[ExecOptions] = None,
+    ) -> List[Any]:
+        if not index:
+            raise PilosaError("index required")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        if isinstance(query, str):
+            query = pql_parser.parse(query)
+        if self.max_writes_per_request > 0 and len(query.write_calls()) > self.max_writes_per_request:
+            raise TooManyWritesError(
+                f"too many writes: {len(query.write_calls())} > {self.max_writes_per_request}"
+            )
+        opt = opt or ExecOptions()
+
+        for call in query.calls:
+            self._translate_call(index, idx, call)
+
+        needs_shards = any(c.name not in _WRITE_CALLS for c in query.calls)
+        if not shards and needs_shards:
+            shards = list(range(idx.max_shard() + 1))
+        shards = list(shards or [])
+
+        results = []
+        for call in query.calls:
+            results.append(self._execute_call(index, call, shards, opt))
+
+        return [
+            self._translate_result(index, idx, call, r)
+            for call, r in zip(query.calls, results)
+        ]
+
+    def _execute_call(self, index: str, c: Call, shards: List[int], opt: ExecOptions):
+        if c.name == "Sum":
+            return self._execute_val_count(index, c, shards, opt, "sum")
+        if c.name == "Min":
+            return self._execute_val_count(index, c, shards, opt, "min")
+        if c.name == "Max":
+            return self._execute_val_count(index, c, shards, opt, "max")
+        if c.name == "Count":
+            return self._execute_count(index, c, shards, opt)
+        if c.name == "Set":
+            return self._execute_set_bit(index, c, opt)
+        if c.name == "Clear":
+            return self._execute_clear_bit(index, c, opt)
+        if c.name == "SetValue":
+            self._execute_set_value(index, c, opt)
+            return None
+        if c.name == "SetRowAttrs":
+            self._execute_set_row_attrs(index, c, opt)
+            return None
+        if c.name == "SetColumnAttrs":
+            self._execute_set_column_attrs(index, c, opt)
+            return None
+        if c.name == "TopN":
+            return self._execute_topn(index, c, shards, opt)
+        return self._execute_bitmap_call(index, c, shards, opt)
+
+    # ----------------------------------------------------------- mapReduce
+
+    def _map_reduce(self, index: str, shards: List[int], c: Call, opt: ExecOptions, map_fn, reduce_fn):
+        """Group shards by owning node; local shards run concurrently on the
+        device, remote nodes get one batched query (executor.go:1464-1593)."""
+        result = None
+        by_node: Dict[str, List[int]] = {}
+        for shard in shards:
+            nodes = self.cluster.shard_nodes(index, shard)
+            # Prefer self if a replica; else primary (reference picks the
+            # option that maximizes local work, executor.go:1444-1458).
+            owner = next((n for n in nodes if n.id == self.node.id), nodes[0])
+            by_node.setdefault(owner.id, []).append(shard)
+
+        for node_id, node_shards in by_node.items():
+            if node_id == self.node.id:
+                if self._pool is not None and len(node_shards) > 1:
+                    values = list(self._pool.map(map_fn, node_shards))
+                else:
+                    values = [map_fn(s) for s in node_shards]
+                for v in values:
+                    result = v if result is None else reduce_fn(result, v)
+            else:
+                if opt.remote:
+                    continue  # remote calls are restricted to local shards
+                node = self.cluster.node_by_id(node_id)
+                remote_results = self.client.query_node(
+                    node, index, str(c), shards=node_shards, remote=True
+                )
+                v = remote_results[0]
+                result = v if result is None else reduce_fn(result, v)
+        return result
+
+    # ------------------------------------------------------------- bitmaps
+
+    def _execute_bitmap_call(self, index: str, c: Call, shards: List[int], opt: ExecOptions) -> Row:
+        def map_fn(shard):
+            return self._execute_bitmap_call_shard(index, c, shard)
+
+        def reduce_fn(prev, v):
+            prev.merge(v)
+            return prev
+
+        row = self._map_reduce(index, shards, c, opt, map_fn, reduce_fn) or Row()
+
+        if c.name == "Row" and not opt.exclude_row_attrs:
+            idx = self.holder.index(index)
+            if idx is not None:
+                field_name = c.field_arg()
+                fld = idx.field(field_name)
+                if fld is not None:
+                    row_id, ok = c.uint_arg(field_name)
+                    if ok:
+                        row.attrs = fld.row_attr_store.attrs(row_id)
+        if opt.exclude_columns:
+            row.segments = {}
+        return row
+
+    def _execute_bitmap_call_shard(self, index: str, c: Call, shard: int) -> Row:
+        if c.name == "Row":
+            return self._execute_row_shard(index, c, shard)
+        if c.name == "Difference":
+            return self._execute_nary_shard(index, c, shard, "difference")
+        if c.name == "Intersect":
+            return self._execute_nary_shard(index, c, shard, "intersect")
+        if c.name == "Union":
+            return self._execute_nary_shard(index, c, shard, "union")
+        if c.name == "Xor":
+            return self._execute_nary_shard(index, c, shard, "xor")
+        if c.name == "Range":
+            return self._execute_range_shard(index, c, shard)
+        raise QueryError(f"unknown call: {c.name}")
+
+    def _execute_row_shard(self, index: str, c: Call, shard: int) -> Row:
+        field_name = c.field_arg()
+        fld = self.holder.field(index, field_name)
+        if fld is None:
+            raise FieldNotFoundError(field_name)
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise QueryError("Row() must specify row")
+        frag = self.holder.fragment(index, field_name, VIEW_STANDARD, shard)
+        if frag is None:
+            return Row()
+        return frag.row(row_id)
+
+    def _execute_nary_shard(self, index: str, c: Call, shard: int, op: str) -> Row:
+        if not c.children and op in ("difference", "intersect"):
+            raise QueryError(f"empty {c.name} query is currently not supported")
+        rows = [self._execute_bitmap_call_shard(index, ch, shard) for ch in c.children]
+        if not rows:
+            return Row()
+        out = rows[0]
+        for r in rows[1:]:
+            out = getattr(out, op)(r)
+        return out
+
+    def _execute_range_shard(self, index: str, c: Call, shard: int) -> Row:
+        if c.has_condition_arg():
+            return self._execute_bsi_range_shard(index, c, shard)
+
+        field_name = c.field_arg()
+        fld = self.holder.field(index, field_name)
+        if fld is None:
+            raise FieldNotFoundError(field_name)
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise QueryError("Range() must specify row")
+        start = c.args.get("_start")
+        end = c.args.get("_end")
+        if not isinstance(start, str) or not isinstance(end, str):
+            raise QueryError("Range() start/end time required")
+        start_t, end_t = parse_timestamp(start), parse_timestamp(end)
+        q = fld.time_quantum()
+        if not q:
+            return Row()
+        row = Row()
+        for view_name in views_by_time_range(VIEW_STANDARD, start_t, end_t, q):
+            frag = self.holder.fragment(index, field_name, view_name, shard)
+            if frag is not None:
+                row.merge(frag.row(row_id))
+        return row
+
+    def _execute_bsi_range_shard(self, index: str, c: Call, shard: int) -> Row:
+        if len(c.args) == 0:
+            raise QueryError("Range(): condition required")
+        if len(c.args) > 1:
+            raise QueryError("Range(): too many arguments")
+        (field_name, cond), = c.args.items()
+        if not isinstance(cond, Condition):
+            raise QueryError(f"Range(): expected condition argument, got {cond!r}")
+        fld = self.holder.field(index, field_name)
+        if fld is None:
+            raise FieldNotFoundError(field_name)
+        bsig = fld.bsi_group(field_name)
+        if bsig is None:
+            raise BSIGroupNotFoundError(field_name)
+        frag = self.holder.fragment(index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, shard)
+
+        if cond.op == NEQ and cond.value is None:  # != null
+            return frag.not_null(bsig.bit_depth()) if frag else Row()
+
+        if cond.op == BETWEEN:
+            predicates = cond.int_slice_value()
+            if len(predicates) != 2:
+                raise QueryError("Range(): BETWEEN condition requires exactly two integer values")
+            lo, hi, out_of_range = bsig.base_value_between(*predicates)
+            if out_of_range or frag is None:
+                return Row()
+            if predicates[0] <= bsig.min and predicates[1] >= bsig.max:
+                return frag.not_null(bsig.bit_depth())
+            return frag.range_between(bsig.bit_depth(), lo, hi)
+
+        if not isinstance(cond.value, int) or isinstance(cond.value, bool):
+            raise QueryError("Range(): conditions only support integer values")
+        value = cond.value
+        base, out_of_range = bsig.base_value(cond.op, value)
+        if out_of_range and cond.op != NEQ:
+            return Row()
+        if frag is None:
+            return Row()
+        # Full-range LT/GT collapse to not-null (executor.go:938-948).
+        if (
+            (cond.op == LT and value > bsig.max)
+            or (cond.op == LTE and value >= bsig.max)
+            or (cond.op == GT and value < bsig.min)
+            or (cond.op == GTE and value <= bsig.min)
+        ):
+            return frag.not_null(bsig.bit_depth())
+        if out_of_range and cond.op == NEQ:
+            return frag.not_null(bsig.bit_depth())
+        return frag.range_op(cond.op, bsig.bit_depth(), base)
+
+    # --------------------------------------------------------------- count
+
+    def _execute_count(self, index: str, c: Call, shards: List[int], opt: ExecOptions) -> int:
+        if len(c.children) == 0:
+            raise QueryError("Count() requires an input bitmap")
+        if len(c.children) > 1:
+            raise QueryError("Count() only accepts a single bitmap input")
+        child = c.children[0]
+
+        def map_fn(shard):
+            return self._execute_bitmap_call_shard(index, child, shard).count()
+
+        result = self._map_reduce(index, shards, c, opt, map_fn, lambda a, b: a + b)
+        return int(result or 0)
+
+    # --------------------------------------------------------- sum/min/max
+
+    def _execute_val_count(self, index: str, c: Call, shards: List[int], opt: ExecOptions, kind: str) -> ValCount:
+        if not c.args.get("field"):
+            raise QueryError(f"{c.name}(): field required")
+        if len(c.children) > 1:
+            raise QueryError(f"{c.name}() only accepts a single bitmap input")
+
+        def map_fn(shard):
+            return self._execute_val_count_shard(index, c, shard, kind)
+
+        def reduce_fn(prev, v):
+            if kind == "sum":
+                return prev.add(v)
+            if kind == "min":
+                return prev.smaller(v)
+            return prev.larger(v)
+
+        result = self._map_reduce(index, shards, c, opt, map_fn, reduce_fn) or ValCount()
+        if result.count == 0:
+            return ValCount()
+        return result
+
+    def _execute_val_count_shard(self, index: str, c: Call, shard: int, kind: str) -> ValCount:
+        filter_row = None
+        if len(c.children) == 1:
+            filter_row = self._execute_bitmap_call_shard(index, c.children[0], shard)
+        field_name = c.args.get("field")
+        fld = self.holder.field(index, field_name)
+        if fld is None:
+            return ValCount()
+        bsig = fld.bsi_group(field_name)
+        if bsig is None:
+            return ValCount()
+        frag = self.holder.fragment(index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, shard)
+        if frag is None:
+            return ValCount()
+        if kind == "sum":
+            vsum, vcount = frag.sum(filter_row, bsig.bit_depth())
+            return ValCount(val=vsum + vcount * bsig.min, count=vcount)
+        if kind == "min":
+            v, cnt = frag.min(filter_row, bsig.bit_depth())
+        else:
+            v, cnt = frag.max(filter_row, bsig.bit_depth())
+        return ValCount(val=v + bsig.min if cnt else 0, count=cnt)
+
+    # ----------------------------------------------------------------- TopN
+
+    def _execute_topn(self, index: str, c: Call, shards: List[int], opt: ExecOptions) -> List[Pair]:
+        ids_arg = self._uint_slice_arg(c, "ids")
+        n, _ = c.uint_arg("n")
+
+        pairs = self._execute_topn_shards(index, c, shards, opt)
+        if not pairs or ids_arg or opt.remote:
+            return pairs
+
+        # Phase 2: refetch full counts for the merged candidate ids
+        # (executor.go:524-560).
+        other = Call(c.name, dict(c.args), list(c.children))
+        other.args["ids"] = sorted({p.id for p in pairs})
+        trimmed = self._execute_topn_shards(index, other, shards, opt)
+        if n and len(trimmed) > n:
+            trimmed = trimmed[:n]
+        return trimmed
+
+    def _execute_topn_shards(self, index: str, c: Call, shards: List[int], opt: ExecOptions) -> List[Pair]:
+        def map_fn(shard):
+            return self._execute_topn_shard(index, c, shard)
+
+        result = self._map_reduce(index, shards, c, opt, map_fn, add_pairs) or []
+        return sort_pairs(result)
+
+    def _execute_topn_shard(self, index: str, c: Call, shard: int) -> List[Pair]:
+        field_name = c.args.get("_field") or DEFAULT_FIELD
+        n, _ = c.uint_arg("n")
+        attr_name = c.args.get("attrName", "")
+        row_ids = self._uint_slice_arg(c, "ids")
+        min_threshold, _ = c.uint_arg("threshold")
+        attr_values = c.args.get("attrValues") or []
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        if tanimoto > 100:
+            raise QueryError("Tanimoto Threshold is from 1 to 100 only")
+
+        src = None
+        if len(c.children) == 1:
+            src = self._execute_bitmap_call_shard(index, c.children[0], shard)
+        elif len(c.children) > 1:
+            raise QueryError("TopN() can only have one input bitmap")
+
+        frag = self.holder.fragment(index, field_name, VIEW_STANDARD, shard)
+        if frag is None:
+            return []
+        return frag.top(
+            TopOptions(
+                n=n,
+                src=src,
+                row_ids=row_ids,
+                min_threshold=min_threshold or DEFAULT_MIN_THRESHOLD,
+                filter_name=attr_name,
+                filter_values=attr_values,
+                tanimoto_threshold=tanimoto,
+            )
+        )
+
+    @staticmethod
+    def _uint_slice_arg(c: Call, key: str) -> List[int]:
+        v = c.args.get(key)
+        if v is None:
+            return []
+        if not isinstance(v, list):
+            raise QueryError(f"invalid call.Args[{key}]: {v!r}")
+        return [int(x) for x in v]
+
+    # --------------------------------------------------------------- writes
+
+    def _for_shard_owners(self, index: str, c: Call, shard: int, opt: ExecOptions, local_fn):
+        """Apply a write locally and forward to other owners (executor.go:1109)."""
+        ret = False
+        for node in self.cluster.shard_nodes(index, shard):
+            if node.id == self.node.id:
+                if local_fn():
+                    ret = True
+                continue
+            if opt.remote:
+                continue
+            res = self.client.query_node(node, index, str(c), remote=True)
+            if res and isinstance(res[0], bool):
+                ret = ret or res[0]
+        return ret
+
+    def _execute_set_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
+        field_name = c.field_arg()
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        fld = idx.field(field_name)
+        if fld is None:
+            raise FieldNotFoundError(field_name)
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise QueryError("Set() row argument required")
+        col_id, ok = c.uint_arg("_col")
+        if not ok:
+            raise QueryError("Set() column argument required")
+        timestamp = None
+        ts = c.args.get("_timestamp")
+        if isinstance(ts, str):
+            timestamp = parse_timestamp(ts)
+        shard = col_id // SHARD_WIDTH
+        return self._for_shard_owners(
+            index, c, shard, opt, lambda: fld.set_bit(row_id, col_id, timestamp)
+        )
+
+    def _execute_clear_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
+        field_name = c.field_arg()
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        fld = idx.field(field_name)
+        if fld is None:
+            raise FieldNotFoundError(field_name)
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise QueryError("Clear() row argument required")
+        col_id, ok = c.uint_arg("_col")
+        if not ok:
+            raise QueryError("Clear() column argument required")
+        shard = col_id // SHARD_WIDTH
+        return self._for_shard_owners(
+            index, c, shard, opt, lambda: fld.clear_bit(row_id, col_id)
+        )
+
+    def _execute_set_value(self, index: str, c: Call, opt: ExecOptions) -> None:
+        col_id, ok = c.uint_arg("col")
+        if not ok:
+            raise QueryError("SetValue() col argument required")
+        args = {k: v for k, v in c.args.items() if k != "col"}
+        for name, value in args.items():
+            fld = self.holder.field(index, name)
+            if fld is None:
+                raise FieldNotFoundError(name)
+            if not isinstance(value, int) or isinstance(value, bool):
+                raise QueryError("invalid BSI group value type")
+            fld.set_value(col_id, value)
+        self._forward_to_all(index, c, opt)
+
+    def _execute_set_row_attrs(self, index: str, c: Call, opt: ExecOptions) -> None:
+        field_name = c.args.get("_field")
+        fld = self.holder.field(index, field_name)
+        if fld is None:
+            raise FieldNotFoundError(field_name)
+        row_id, ok = c.uint_arg("_row")
+        if not ok:
+            raise QueryError("SetRowAttrs() row argument required")
+        attrs = {k: v for k, v in c.args.items() if k not in ("_field", "_row")}
+        fld.row_attr_store.set_attrs(row_id, attrs)
+        self._forward_to_all(index, c, opt)
+
+    def _execute_set_column_attrs(self, index: str, c: Call, opt: ExecOptions) -> None:
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        col, ok = c.uint_arg("_col")
+        if not ok:
+            raise QueryError("SetColumnAttrs() col argument required")
+        attrs = {k: v for k, v in c.args.items() if k not in ("_col", "field")}
+        idx.column_attr_store.set_attrs(col, attrs)
+        self._forward_to_all(index, c, opt)
+
+    def _forward_to_all(self, index: str, c: Call, opt: ExecOptions) -> None:
+        if opt.remote:
+            return
+        for node in self.cluster.nodes:
+            if node.id == self.node.id:
+                continue
+            self.client.query_node(node, index, str(c), remote=True)
+
+    # ---------------------------------------------------------- translation
+
+    def _translate_call(self, index: str, idx, c: Call) -> None:
+        """Translate string keys to ids in-place (executor.go:1595-1659)."""
+        store = self.translate_store
+        if store is not None:
+            col = c.args.get("_col")
+            if isinstance(col, str):
+                if not idx.keys():
+                    raise QueryError(f"string 'col' value not allowed unless index 'keys' option enabled: {col!r}")
+                c.args["_col"] = store.translate_columns_to_uint64(index, [col])[0]
+            for key in list(c.args):
+                if key.startswith("_") or key in ("field",):
+                    continue
+                value = c.args[key]
+                fld = idx.field(key)
+                if fld is not None and isinstance(value, str):
+                    if not fld.keys():
+                        raise QueryError(f"string 'row' value not allowed unless field 'keys' option enabled: {value!r}")
+                    c.args[key] = store.translate_rows_to_uint64(index, key, [value])[0]
+        for child in c.children:
+            self._translate_call(index, idx, child)
+
+    def _translate_result(self, index: str, idx, c: Call, result):
+        store = self.translate_store
+        if store is None:
+            return result
+        if isinstance(result, Row) and idx.keys():
+            result.keys = store.translate_columns_to_string(
+                index, [int(x) for x in result.columns()]
+            )
+        if isinstance(result, list) and result and isinstance(result[0], Pair):
+            field_name = c.args.get("_field")
+            fld = idx.field(field_name) if field_name else None
+            if fld is not None and fld.keys():
+                result = [
+                    Pair(id=p.id, count=p.count,
+                         key=store.translate_row_to_string(index, field_name, p.id))
+                    for p in result
+                ]
+        return result

@@ -1,0 +1,132 @@
+"""String key <-> uint64 id translation.
+
+Equivalent of the reference's TranslateFile (translate.go): an append-only
+log of (namespace, key, id) entries replayed into in-memory maps on open.
+Namespaces are per-index column keys ("i:<index>") and per-field row keys
+("f:<index>:<field>"). Ids are 1-based dense sequences per namespace (the
+reference's allocator semantics).
+
+Read-only replicas can follow a primary by streaming the log (reference
+PrimaryTranslateStore, translate.go:259-310) — see server/client.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence
+
+
+class TranslateStore:
+    def __init__(self, path: Optional[str] = None, read_only: bool = False):
+        self.path = path
+        self.read_only = read_only
+        self._lock = threading.Lock()
+        self._key_to_id: Dict[str, Dict[str, int]] = {}
+        self._id_to_key: Dict[str, Dict[int, str]] = {}
+        self._log = None
+        self._size = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def open(self) -> "TranslateStore":
+        if self.path and os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos + 4 <= len(data):
+                (n,) = struct.unpack_from("<I", data, pos)
+                if pos + 4 + n > len(data):
+                    break  # truncated trailing entry
+                ns, key, id = json.loads(data[pos + 4 : pos + 4 + n])
+                self._apply(ns, key, id)
+                pos += 4 + n
+            self._size = pos
+        if self.path and not self.read_only:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._log = open(self.path, "ab")
+        return self
+
+    def close(self) -> None:
+        if self._log:
+            self._log.close()
+            self._log = None
+
+    def _apply(self, ns: str, key: str, id: int) -> None:
+        self._key_to_id.setdefault(ns, {})[key] = id
+        self._id_to_key.setdefault(ns, {})[id] = key
+
+    def _append(self, ns: str, key: str, id: int) -> None:
+        if self._log:
+            entry = json.dumps([ns, key, id]).encode()
+            self._log.write(struct.pack("<I", len(entry)) + entry)
+            self._log.flush()
+            self._size += 4 + len(entry)
+
+    # ----------------------------------------------------------- translate
+
+    def _create(self, ns: str, keys: Sequence[str]) -> List[int]:
+        from .errors import TranslateStoreReadOnlyError
+
+        out = []
+        with self._lock:
+            m = self._key_to_id.setdefault(ns, {})
+            for key in keys:
+                id = m.get(key)
+                if id is None:
+                    if self.read_only:
+                        raise TranslateStoreReadOnlyError(ns)
+                    id = len(m) + 1
+                    self._apply(ns, key, id)
+                    self._append(ns, key, id)
+                out.append(id)
+        return out
+
+    def translate_columns_to_uint64(self, index: str, keys: Sequence[str]) -> List[int]:
+        return self._create(f"i:{index}", keys)
+
+    def translate_column_to_string(self, index: str, id: int) -> str:
+        return self._id_to_key.get(f"i:{index}", {}).get(id, "")
+
+    def translate_columns_to_string(self, index: str, ids: Sequence[int]) -> List[str]:
+        m = self._id_to_key.get(f"i:{index}", {})
+        return [m.get(i, "") for i in ids]
+
+    def translate_rows_to_uint64(self, index: str, field: str, keys: Sequence[str]) -> List[int]:
+        return self._create(f"f:{index}:{field}", keys)
+
+    def translate_row_to_string(self, index: str, field: str, id: int) -> str:
+        return self._id_to_key.get(f"f:{index}:{field}", {}).get(id, "")
+
+    def translate_rows_to_string(self, index: str, field: str, ids: Sequence[int]) -> List[str]:
+        m = self._id_to_key.get(f"f:{index}:{field}", {})
+        return [m.get(i, "") for i in ids]
+
+    # ---------------------------------------------------------- replication
+
+    def size(self) -> int:
+        return self._size
+
+    def read_from(self, offset: int):
+        """Raw log bytes from offset (for replica streaming)."""
+        if not self.path or not os.path.exists(self.path):
+            return b""
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            return f.read()
+
+    def apply_log(self, data: bytes) -> int:
+        """Apply streamed log bytes on a replica; returns bytes consumed."""
+        pos = 0
+        with self._lock:
+            while pos + 4 <= len(data):
+                (n,) = struct.unpack_from("<I", data, pos)
+                if pos + 4 + n > len(data):
+                    break
+                ns, key, id = json.loads(data[pos + 4 : pos + 4 + n])
+                self._apply(ns, key, id)
+                pos += 4 + n
+            self._size += pos
+        return pos

@@ -1,0 +1,219 @@
+"""Executor tests driving full PQL strings on a single in-process node
+(model: /root/reference/executor_test.go, which uses test.MustRunCluster).
+Bit patterns deliberately span shards (SHARD_WIDTH+x) to exercise the
+map/reduce path."""
+
+import pytest
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import IndexOptions
+from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.translate import TranslateStore
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+@pytest.fixture
+def ex(holder):
+    return Executor(holder, translate_store=TranslateStore().open(), workers=0)
+
+
+def setup_index(holder, name="i", keys=False):
+    idx = holder.create_index_if_not_exists(name, IndexOptions(keys=keys))
+    idx.create_field_if_not_exists("f")
+    idx.create_field_if_not_exists("g")
+    return idx
+
+
+def test_row_and_set(holder, ex):
+    setup_index(holder)
+    res = ex.execute("i", "Set(3, f=10)")
+    assert res == [True]
+    res = ex.execute("i", "Set(3, f=10)")
+    assert res == [False]  # already set
+    ex.execute("i", f"Set({SHARD_WIDTH + 1}, f=10)")
+    row = ex.execute("i", "Row(f=10)")[0]
+    assert list(row.columns()) == [3, SHARD_WIDTH + 1]
+
+
+def test_clear(holder, ex):
+    setup_index(holder)
+    ex.execute("i", "Set(3, f=10)")
+    assert ex.execute("i", "Clear(3, f=10)") == [True]
+    assert ex.execute("i", "Clear(3, f=10)") == [False]
+    assert list(ex.execute("i", "Row(f=10)")[0].columns()) == []
+
+
+def test_intersect_cross_shard(holder, ex):
+    setup_index(holder)
+    for col in [1, 100, SHARD_WIDTH, SHARD_WIDTH + 2]:
+        ex.execute("i", f"Set({col}, f=10)")
+    for col in [1, SHARD_WIDTH + 2, 2 * SHARD_WIDTH]:
+        ex.execute("i", f"Set({col}, g=20)")
+    row = ex.execute("i", "Intersect(Row(f=10), Row(g=20))")[0]
+    assert list(row.columns()) == [1, SHARD_WIDTH + 2]
+    assert ex.execute("i", "Count(Intersect(Row(f=10), Row(g=20)))") == [2]
+
+
+def test_union_difference_xor(holder, ex):
+    setup_index(holder)
+    for col in [0, 2, SHARD_WIDTH]:
+        ex.execute("i", f"Set({col}, f=1)")
+    for col in [2, 3]:
+        ex.execute("i", f"Set({col}, g=2)")
+    assert list(ex.execute("i", "Union(Row(f=1), Row(g=2))")[0].columns()) == [0, 2, 3, SHARD_WIDTH]
+    assert list(ex.execute("i", "Difference(Row(f=1), Row(g=2))")[0].columns()) == [0, SHARD_WIDTH]
+    assert list(ex.execute("i", "Xor(Row(f=1), Row(g=2))")[0].columns()) == [0, 3, SHARD_WIDTH]
+
+
+def test_count(holder, ex):
+    setup_index(holder)
+    for col in [1, 2, SHARD_WIDTH + 5]:
+        ex.execute("i", f"Set({col}, f=7)")
+    assert ex.execute("i", "Count(Row(f=7))") == [3]
+
+
+def test_topn_two_phase_cross_shard(holder, ex):
+    setup_index(holder)
+    # Row 10: 2 bits in shard 0, 2 bits in shard 1 (total 4).
+    # Row 20: 3 bits in shard 0 (total 3). Row 30: 1 bit.
+    for col in [0, 1, SHARD_WIDTH, SHARD_WIDTH + 1]:
+        ex.execute("i", f"Set({col}, f=10)")
+    for col in [2, 3, 4]:
+        ex.execute("i", f"Set({col}, f=20)")
+    ex.execute("i", "Set(5, f=30)")
+    pairs = ex.execute("i", "TopN(f, n=2)")[0]
+    assert [(p.id, p.count) for p in pairs] == [(10, 4), (20, 3)]
+    pairs = ex.execute("i", "TopN(f)")[0]
+    assert [(p.id, p.count) for p in pairs] == [(10, 4), (20, 3), (30, 1)]
+
+
+def test_topn_with_src(holder, ex):
+    setup_index(holder)
+    for col in [0, 1, 2]:
+        ex.execute("i", f"Set({col}, f=10)")
+    for col in [1, 2, 3, 4]:
+        ex.execute("i", f"Set({col}, f=20)")
+    for col in [0, 1]:
+        ex.execute("i", f"Set({col}, g=5)")
+    pairs = ex.execute("i", "TopN(f, Row(g=5), n=2)")[0]
+    assert [(p.id, p.count) for p in pairs] == [(10, 2), (20, 1)]
+
+
+def test_sum_min_max(holder, ex):
+    idx = setup_index(holder)
+    idx.create_field_if_not_exists("v", FieldOptions(type="int", min=-10, max=1000))
+    ex.execute("i", "SetValue(col=1, v=5)")
+    ex.execute("i", "SetValue(col=2, v=-10)")
+    ex.execute("i", f"SetValue(col={SHARD_WIDTH + 3}, v=1000)")
+    ex.execute("i", "Set(1, f=1)")
+    ex.execute("i", "Set(2, f=1)")
+    assert ex.execute("i", "Sum(field=v)")[0].to_dict() == {"value": 995, "count": 3}
+    assert ex.execute("i", "Min(field=v)")[0].to_dict() == {"value": -10, "count": 1}
+    assert ex.execute("i", "Max(field=v)")[0].to_dict() == {"value": 1000, "count": 1}
+    # Filtered by Row(f=1) → columns 1, 2.
+    assert ex.execute("i", "Sum(Row(f=1), field=v)")[0].to_dict() == {"value": -5, "count": 2}
+    assert ex.execute("i", "Max(Row(f=1), field=v)")[0].to_dict() == {"value": 5, "count": 1}
+
+
+def test_bsi_range_queries(holder, ex):
+    idx = setup_index(holder)
+    idx.create_field_if_not_exists("v", FieldOptions(type="int", min=0, max=100))
+    for col, val in [(1, 10), (2, 20), (3, 30), (SHARD_WIDTH + 4, 40)]:
+        ex.execute("i", f"SetValue(col={col}, v={val})")
+    assert list(ex.execute("i", "Range(v == 20)")[0].columns()) == [2]
+    assert list(ex.execute("i", "Range(v != 20)")[0].columns()) == [1, 3, SHARD_WIDTH + 4]
+    assert list(ex.execute("i", "Range(v < 30)")[0].columns()) == [1, 2]
+    assert list(ex.execute("i", "Range(v <= 30)")[0].columns()) == [1, 2, 3]
+    assert list(ex.execute("i", "Range(v > 20)")[0].columns()) == [3, SHARD_WIDTH + 4]
+    assert list(ex.execute("i", "Range(15 < v < 35)")[0].columns()) == [2, 3]
+    assert list(ex.execute("i", "Range(v >< [20, 40])")[0].columns()) == [2, 3, SHARD_WIDTH + 4]
+    assert list(ex.execute("i", "Range(v != null)")[0].columns()) == [1, 2, 3, SHARD_WIDTH + 4]
+    # Out of range → empty.
+    assert list(ex.execute("i", "Range(v == 999)")[0].columns()) == []
+    # Full-range collapse to not-null.
+    assert list(ex.execute("i", "Range(v < 999)")[0].columns()) == [1, 2, 3, SHARD_WIDTH + 4]
+
+
+def test_time_range(holder, ex):
+    idx = holder.create_index_if_not_exists("t")
+    idx.create_field_if_not_exists("f", FieldOptions(type="time", time_quantum="YMDH"))
+    ex.execute("t", "Set(1, f=1, 2010-01-01T00:00)")
+    ex.execute("t", "Set(2, f=1, 2010-01-02T00:00)")
+    ex.execute("t", "Set(3, f=1, 2010-02-01T00:00)")
+    row = ex.execute("t", "Range(f=1, 2010-01-01T00:00, 2010-01-03T00:00)")[0]
+    assert list(row.columns()) == [1, 2]
+    row = ex.execute("t", "Range(f=1, 2009-12-01T00:00, 2010-03-01T00:00)")[0]
+    assert list(row.columns()) == [1, 2, 3]
+    # Standard view still has all bits.
+    assert list(ex.execute("t", "Row(f=1)")[0].columns()) == [1, 2, 3]
+
+
+def test_row_attrs(holder, ex):
+    setup_index(holder)
+    ex.execute("i", 'SetRowAttrs(f, 10, foo="bar", count=123)')
+    ex.execute("i", "Set(1, f=10)")
+    row = ex.execute("i", "Row(f=10)")[0]
+    assert row.attrs == {"foo": "bar", "count": 123}
+    row = ex.execute("i", "Row(f=10)", opt=ExecOptions(exclude_row_attrs=True))[0]
+    assert row.attrs == {}
+
+
+def test_column_attrs(holder, ex):
+    setup_index(holder)
+    ex.execute("i", 'SetColumnAttrs(7, name="alice")')
+    assert holder.index("i").column_attr_store.attrs(7) == {"name": "alice"}
+
+
+def test_topn_attr_filter(holder, ex):
+    setup_index(holder)
+    for col in range(4):
+        ex.execute("i", f"Set({col}, f=10)")
+    for col in range(2):
+        ex.execute("i", f"Set({col}, f=20)")
+    ex.execute("i", 'SetRowAttrs(f, 10, category="x")')
+    ex.execute("i", 'SetRowAttrs(f, 20, category="y")')
+    pairs = ex.execute("i", 'TopN(f, n=5, attrName="category", attrValues=["y"])')[0]
+    assert [(p.id, p.count) for p in pairs] == [(20, 2)]
+
+
+def test_key_translation(holder, ex):
+    idx = holder.create_index_if_not_exists("k", IndexOptions(keys=True))
+    idx.create_field_if_not_exists("f", FieldOptions(keys=True))
+    ex.execute("k", 'Set("alice", f="red")')
+    ex.execute("k", 'Set("bob", f="red")')
+    row = ex.execute("k", 'Row(f="red")')[0]
+    assert sorted(row.keys) == ["alice", "bob"]
+    pairs = ex.execute("k", "TopN(f, n=1)")[0]
+    assert pairs[0].key == "red"
+    assert pairs[0].count == 2
+
+
+def test_error_on_unknown_field(holder, ex):
+    setup_index(holder)
+    with pytest.raises(Exception):
+        ex.execute("i", "Row(nosuch=1)")
+
+
+def test_write_limit(holder, ex):
+    setup_index(holder)
+    ex.max_writes_per_request = 2
+    with pytest.raises(Exception):
+        ex.execute("i", "Set(1, f=1) Set(2, f=1) Set(3, f=1)")
+
+
+def test_durability_across_reopen(holder, ex, tmp_path):
+    setup_index(holder)
+    ex.execute("i", "Set(3, f=10)")
+    ex.execute("i", f"Set({SHARD_WIDTH + 7}, f=10)")
+    holder.reopen()
+    ex2 = Executor(holder, translate_store=TranslateStore().open(), workers=0)
+    assert list(ex2.execute("i", "Row(f=10)")[0].columns()) == [3, SHARD_WIDTH + 7]
