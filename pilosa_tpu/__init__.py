@@ -1,0 +1,29 @@
+"""pilosa_tpu: a TPU-native distributed bitmap index.
+
+A from-scratch framework with the capability surface of Pilosa (the
+reference Go implementation): roaring-format storage, PQL queries,
+index/field/view/shard data model, HTTP API, and cluster semantics —
+re-architected so all bitmap compute runs as dense bitplane kernels on
+TPU (JAX/XLA/Pallas) with shard-parallel execution over device meshes.
+"""
+
+__version__ = "0.1.0"
+
+from .core.holder import Holder
+from .core.index import IndexOptions
+from .core.field import FieldOptions
+from .core.row import Row
+from .executor import ExecOptions, Executor, ValCount
+from .pql.parser import parse as parse_pql
+
+__all__ = [
+    "Holder",
+    "IndexOptions",
+    "FieldOptions",
+    "Row",
+    "Executor",
+    "ExecOptions",
+    "ValCount",
+    "parse_pql",
+    "__version__",
+]

@@ -1,0 +1,192 @@
+"""Cluster resize: coordinator-driven shard redistribution.
+
+Port of the reference's resizeJob flow (cluster.go:1080-1423): when a node
+joins/leaves with data present, the coordinator diffs old-vs-new shard
+placement, builds one ResizeInstruction per node listing fragment sources,
+broadcasts RESIZING, each node streams the fragments it is gaining from
+source peers, acks with resize-complete, and the coordinator flips the
+cluster back to NORMAL and broadcasts the new status.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+from ..cluster.node import Cluster, Node, STATE_NORMAL, STATE_RESIZING
+from ..errors import PilosaError
+
+
+def fragment_sources(
+    old_cluster: Cluster, new_cluster: Cluster, schema: List[dict], max_shards: Dict[str, int]
+) -> Dict[str, List[dict]]:
+    """Per-node list of fragments each node must fetch, with a source node
+    owning that fragment in the old placement (cluster.go:689 fragSources)."""
+    sources: Dict[str, List[dict]] = {n.id: [] for n in new_cluster.nodes}
+    old_ids = {n.id for n in old_cluster.nodes}
+    for idx_info in schema:
+        index = idx_info["name"]
+        max_shard = max_shards.get(index, 0)
+        for shard in range(max_shard + 1):
+            old_owners = [n.id for n in old_cluster.shard_nodes(index, shard)]
+            new_owners = [n.id for n in new_cluster.shard_nodes(index, shard)]
+            for node_id in new_owners:
+                if node_id in old_owners or node_id not in old_ids and node_id not in sources:
+                    continue
+                if node_id not in sources:
+                    continue
+                src = old_owners[0]
+                for f_info in idx_info.get("fields", []):
+                    for v_info in f_info.get("views", []):
+                        sources[node_id].append(
+                            {
+                                "index": index,
+                                "field": f_info["name"],
+                                "view": v_info["name"],
+                                "shard": shard,
+                                "sourceNodeID": src,
+                            }
+                        )
+    return sources
+
+
+class ResizeJob:
+    def __init__(self, job_id: str, instructions: Dict[str, List[dict]], new_nodes: List[Node]):
+        self.id = job_id
+        self.instructions = instructions
+        self.new_nodes = new_nodes
+        self.acks = {node_id: False for node_id in instructions}
+        self.lock = threading.Lock()
+
+    def ack(self, node_id: str) -> bool:
+        with self.lock:
+            self.acks[node_id] = True
+            return all(self.acks.values())
+
+
+class ResizeCoordinator:
+    """Runs on the coordinator node; one job at a time (cluster.go:1095)."""
+
+    def __init__(self, server):
+        self.server = server
+        self.job: Optional[ResizeJob] = None
+        self._lock = threading.Lock()
+
+    def begin(self, new_nodes: List[Node]) -> None:
+        cluster = self.server.cluster
+        with self._lock:
+            if self.job is not None:
+                raise PilosaError("a resize job is already running")
+            old = Cluster(
+                node=cluster.node,
+                nodes=list(cluster.nodes),
+                replica_n=cluster.replica_n,
+                partition_n=cluster.partition_n,
+                hasher=cluster.hasher,
+            )
+            new = Cluster(
+                node=cluster.node,
+                nodes=sorted(new_nodes, key=lambda n: n.id),
+                replica_n=cluster.replica_n,
+                partition_n=cluster.partition_n,
+                hasher=cluster.hasher,
+            )
+            schema = self.server.holder.schema()
+            max_shards = {
+                name: idx.max_shard() for name, idx in self.server.holder.indexes.items()
+            }
+            sources = fragment_sources(old, new, schema, max_shards)
+            job = ResizeJob(uuid.uuid4().hex[:8], sources, new.nodes)
+            self.job = job
+
+        cluster.state = STATE_RESIZING
+        status = {
+            "type": "cluster-status",
+            "state": STATE_RESIZING,
+            "nodes": [n.to_dict() for n in new.nodes],
+        }
+        self.server.broadcast_message(status)
+
+        node_uris = {n.id: n.uri for n in old.nodes}
+        node_uris.update({n.id: n.uri for n in new.nodes})
+        for node_id, instr_sources in sources.items():
+            msg = {
+                "type": "resize-instruction",
+                "jobID": job.id,
+                "nodeID": node_id,
+                "coordinatorID": cluster.node.id,
+                "coordinatorURI": cluster.node.uri,
+                "schema": schema,
+                "sources": instr_sources,
+                "nodeURIs": node_uris,
+            }
+            if node_id == cluster.node.id:
+                follow_resize_instruction(self.server, msg)
+            else:
+                target = next((n for n in new.nodes if n.id == node_id), None)
+                if target is not None:
+                    self.server.client.send_message(target, msg)
+
+    def complete(self, node_id: str) -> None:
+        with self._lock:
+            job = self.job
+            if job is None:
+                return
+            done = job.ack(node_id)
+            if done:
+                self.job = None
+        if done:
+            cluster = self.server.cluster
+            cluster.nodes = job.new_nodes
+            cluster.state = STATE_NORMAL
+            self.server.broadcast_message(
+                {
+                    "type": "cluster-status",
+                    "state": STATE_NORMAL,
+                    "nodes": [n.to_dict() for n in job.new_nodes],
+                }
+            )
+
+
+def follow_resize_instruction(server, msg: dict) -> None:
+    """Receiver side (cluster.go:1179 followResizeInstruction)."""
+    server.holder.apply_schema(msg.get("schema", []))
+    node_uris = msg.get("nodeURIs", {})
+    for src in msg.get("sources", []):
+        source_uri = node_uris.get(src["sourceNodeID"])
+        if source_uri is None or src["sourceNodeID"] == server.cluster.node.id:
+            continue
+        try:
+            data = server.client.retrieve_shard_from_uri(
+                source_uri, src["index"], src["field"], src["view"], src["shard"]
+            )
+        except PilosaError:
+            continue
+        import io
+
+        fld = server.holder.field(src["index"], src["field"])
+        if fld is None:
+            continue
+        view = fld.create_view_if_not_exists(src["view"])
+        frag = view.create_fragment_if_not_exists(src["shard"])
+        frag.read_from(io.BytesIO(data))
+
+    complete = {
+        "type": "resize-complete",
+        "jobID": msg.get("jobID"),
+        "nodeID": server.cluster.node.id,
+    }
+    if msg.get("coordinatorID") == server.cluster.node.id:
+        mark_resize_instruction_complete(server, complete)
+    else:
+        server.client.send_message(
+            Node(id=msg.get("coordinatorID", ""), uri=msg.get("coordinatorURI", "")),
+            complete,
+        )
+
+
+def mark_resize_instruction_complete(server, msg: dict) -> None:
+    coordinator = getattr(server, "resize_coordinator", None)
+    if coordinator is not None:
+        coordinator.complete(msg.get("nodeID", ""))

@@ -1,0 +1,115 @@
+"""Anti-entropy: merkle-block sync of replicated fragments.
+
+Port of the reference's holderSyncer/fragmentSyncer (holder.go:566-774,
+fragment.go:1716-1904): walk every locally-owned fragment, compare
+HASH_BLOCK_SIZE-row block checksums across replicas, pull differing blocks,
+majority-vote merge locally, and push Set/Clear diffs back to replicas as
+PQL. Attribute stores sync first via block-checksum diff (attr.go:80-120).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..constants import SHARD_WIDTH, VIEW_STANDARD
+from ..errors import PilosaError
+
+
+class HolderSyncer:
+    def __init__(self, server):
+        self.server = server
+        self.holder = server.holder
+        self.cluster = server.cluster
+        self.client = server.client
+
+    def _remote_replicas(self, index: str, shard: int):
+        nodes = self.cluster.shard_nodes(index, shard)
+        me = self.cluster.node.id
+        if not any(n.id == me for n in nodes):
+            return None  # not owned here
+        return [n for n in nodes if n.id != me]
+
+    def sync_holder(self) -> None:
+        for index_name in self.holder.index_names():
+            idx = self.holder.index(index_name)
+            self._sync_attrs(index_name, None, idx.column_attr_store)
+            for field_name in idx.field_names():
+                fld = idx.field(field_name)
+                self._sync_attrs(index_name, field_name, fld.row_attr_store)
+                for view_name in fld.view_names():
+                    view = fld.view(view_name)
+                    for shard in view.available_shards():
+                        replicas = self._remote_replicas(index_name, shard)
+                        if replicas:
+                            self._sync_fragment(
+                                index_name, field_name, view_name, shard, replicas
+                            )
+
+    # ---------------------------------------------------------------- attrs
+
+    def _sync_attrs(self, index: str, field, store) -> None:
+        replicas = [n for n in self.cluster.nodes if n.id != self.cluster.node.id]
+        if not replicas:
+            return
+        blocks = [{"id": bid, "checksum": chk.hex()} for bid, chk in store.blocks()]
+        for node in replicas:
+            try:
+                remote_attrs = self.client.attr_diff(node, index, field, blocks)
+            except PilosaError:
+                continue
+            if remote_attrs:
+                store.set_bulk_attrs(remote_attrs)
+
+    # ------------------------------------------------------------- fragment
+
+    def _sync_fragment(self, index: str, field: str, view: str, shard: int, replicas) -> None:
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            return
+        local_blocks = {b.id: b.checksum for b in frag.blocks()}
+
+        # Gather remote block checksums; union of block ids drives the merge.
+        remote_blocks: List[Tuple[object, Dict[int, bytes]]] = []
+        for node in replicas:
+            try:
+                blocks = self.client.fragment_blocks(node, index, field, shard)
+                remote_blocks.append(
+                    (node, {b["id"]: bytes.fromhex(b["checksum"]) for b in blocks})
+                )
+            except PilosaError:
+                continue
+
+        all_ids = set(local_blocks)
+        for _, blocks in remote_blocks:
+            all_ids.update(blocks)
+
+        for block_id in sorted(all_ids):
+            checksums = [blocks.get(block_id) for _, blocks in remote_blocks]
+            if all(c == local_blocks.get(block_id) for c in checksums):
+                continue
+            self._merge_block(index, field, view, shard, block_id, frag, remote_blocks)
+
+    def _merge_block(self, index, field, view, shard, block_id, frag, remote_blocks) -> None:
+        """Pull remote pairs, consensus-merge, push diffs (fragment.go:1737-1809)."""
+        datas = []
+        nodes = []
+        for node, _ in remote_blocks:
+            try:
+                d = self.client.block_data(node, index, field, view, shard, block_id)
+            except PilosaError:
+                continue
+            datas.append((np.asarray(d["rowIDs"], dtype=np.uint64),
+                          np.asarray(d["columnIDs"], dtype=np.uint64)))
+            nodes.append(node)
+        if not datas:
+            return
+        sets, clears = frag.merge_block(block_id, datas)
+        # Push per-replica diffs as Set/Clear PQL (fragment.go:1814-1903).
+        base = shard * SHARD_WIDTH
+        for node, add, rem in zip(nodes, sets, clears):
+            calls = [f"Set({base + c}, {field}={r})" for r, c in add]
+            calls += [f"Clear({base + c}, {field}={r})" for r, c in rem]
+            if calls:
+                self.client.query_node(node, index, " ".join(calls), remote=True)

@@ -86,16 +86,20 @@ class View:
     def fragment(self, shard: int) -> Optional[Fragment]:
         return self.fragments.get(shard)
 
-    def create_fragment_if_not_exists(self, shard: int) -> Fragment:
+    def create_fragment_if_not_exists(self, shard: int, broadcast: bool = True) -> Fragment:
+        created = False
         with self._lock:
             frag = self.fragments.get(shard)
             if frag is None:
                 frag = self._new_fragment(shard)
                 frag.open()
                 self.fragments[shard] = frag
-                if self.broadcast_shard:
-                    self.broadcast_shard(self.index, self.field, shard)
-            return frag
+                created = True
+        # Broadcast outside the lock: the peer handling CreateShardMessage
+        # takes its own view lock and may call back here (deadlock otherwise).
+        if created and broadcast and self.broadcast_shard:
+            self.broadcast_shard(self.index, self.field, shard)
+        return frag
 
     def available_shards(self) -> List[int]:
         return sorted(self.fragments)

@@ -6,81 +6,87 @@ import re
 class PilosaError(Exception):
     """Base class for all framework errors."""
 
+    message = "error"
+
+    def __str__(self):
+        detail = ", ".join(str(a) for a in self.args)
+        return f"{self.message}: {detail}" if detail else self.message
+
 
 class IndexExistsError(PilosaError):
-    pass
+    message = "index already exists"
 
 
 class IndexNotFoundError(PilosaError):
-    pass
+    message = "index not found"
 
 
 class FieldExistsError(PilosaError):
-    pass
+    message = "field already exists"
 
 
 class FieldNotFoundError(PilosaError):
-    pass
+    message = "field not found"
 
 
 class BSIGroupNotFoundError(PilosaError):
-    pass
+    message = "bsigroup not found"
 
 
 class BSIGroupExistsError(PilosaError):
-    pass
+    message = "bsigroup already exists"
 
 
 class InvalidBSIGroupTypeError(PilosaError):
-    pass
+    message = "invalid bsigroup type"
 
 
 class InvalidBSIGroupRangeError(PilosaError):
-    pass
+    message = "invalid bsigroup range"
 
 
 class InvalidViewError(PilosaError):
-    pass
+    message = "invalid view"
 
 
 class InvalidCacheTypeError(PilosaError):
-    pass
+    message = "invalid cache type"
 
 
 class InvalidFieldTypeError(PilosaError):
-    pass
+    message = "invalid field type"
 
 
 class InvalidTimeQuantumError(PilosaError):
-    pass
+    message = "invalid time quantum"
 
 
 class FragmentNotFoundError(PilosaError):
-    pass
+    message = "fragment not found"
 
 
 class QueryError(PilosaError):
-    pass
+    message = "query error"
 
 
 class TooManyWritesError(PilosaError):
-    pass
+    message = "too many writes"
 
 
 class ClusterDoesNotOwnShardError(PilosaError):
-    pass
+    message = "node does not own shard"
 
 
 class NodeIDNotExistsError(PilosaError):
-    pass
+    message = "node id does not exist"
 
 
 class ColumnRowOutOfRangeError(PilosaError):
-    pass
+    message = "column or row out of range"
 
 
 class TranslateStoreReadOnlyError(PilosaError):
-    pass
+    message = "translate store is read-only"
 
 
 # Name validation (reference: pilosa.go validateName, ^[a-z][a-z0-9_-]{0,63}$).

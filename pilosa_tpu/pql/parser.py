@@ -170,18 +170,28 @@ class Parser:
         name = self.match(_IDENT_RE)
         if name is None:
             self.error("expected call name")
-        if name == "Set" or name == "SetBit":
-            return self.parse_set(name)
-        if name == "SetRowAttrs":
-            return self.parse_set_row_attrs()
-        if name == "SetColumnAttrs":
-            return self.parse_set_column_attrs()
-        if name == "Clear" or name == "ClearBit":
-            return self.parse_clear(name)
-        if name == "TopN":
-            return self.parse_topn()
-        if name == "Range":
-            return self.parse_range()
+        special = {
+            "Set": lambda: self.parse_set(name),
+            "SetBit": lambda: self.parse_set(name),
+            "SetRowAttrs": self.parse_set_row_attrs,
+            "SetColumnAttrs": self.parse_set_column_attrs,
+            "Clear": lambda: self.parse_clear(name),
+            "ClearBit": lambda: self.parse_clear(name),
+            "TopN": self.parse_topn,
+            "Range": self.parse_range,
+        }.get(name)
+        if special is not None:
+            # PEG ordered choice (pql.peg:9-15): if the special form fails,
+            # fall back to the generic IDENT branch — this is what makes
+            # canonical re-serializations like Set(_col=1, f=9) parseable.
+            save = self.pos
+            try:
+                call = special()
+            except ParseError:
+                self.pos = save
+                call = self.parse_generic(name)
+                call.name = {"SetBit": "Set", "ClearBit": "Clear"}.get(name, name)
+            return call
         return self.parse_generic(name)
 
     def open(self):

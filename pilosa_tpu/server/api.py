@@ -1,0 +1,305 @@
+"""API facade: one method per externally-reachable operation.
+
+Port of /root/reference/api.go — the single surface shared by the HTTP
+handler, the cluster-message dispatcher, and the CLI. Methods validate
+against cluster state (api.go:870-939): while RESIZING only resize-abort
+and common methods are allowed.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..cluster.node import STATE_NORMAL, STATE_RESIZING, STATE_STARTING
+from ..constants import SHARD_WIDTH
+from ..core.field import FieldOptions
+from ..core.index import IndexOptions
+from ..core.row import Row
+from ..errors import PilosaError, QueryError
+from ..executor import ExecOptions, Executor, ValCount
+from ..core.cache import Pair
+
+
+class ApiError(PilosaError):
+    pass
+
+
+# Methods valid in any cluster state (api.go apiMethod "common" set).
+_COMMON_METHODS = {
+    "status", "info", "schema", "version", "cluster_message",
+    "resize_abort", "set_coordinator", "state", "shards_max",
+}
+
+
+class API:
+    def __init__(self, server):
+        self.server = server
+
+    @property
+    def holder(self):
+        return self.server.holder
+
+    @property
+    def cluster(self):
+        return self.server.cluster
+
+    @property
+    def executor(self) -> Executor:
+        return self.server.executor
+
+    def _validate(self, method: str) -> None:
+        state = self.cluster.state
+        if state == STATE_NORMAL or method in _COMMON_METHODS:
+            return
+        raise ApiError(f"api method {method} unavailable in state {state}")
+
+    # ---------------------------------------------------------------- query
+
+    def query(
+        self,
+        index: str,
+        query: str,
+        shards: Optional[Sequence[int]] = None,
+        column_attrs: bool = False,
+        exclude_row_attrs: bool = False,
+        exclude_columns: bool = False,
+        remote: bool = False,
+    ) -> List[Any]:
+        self._validate("query")
+        opt = ExecOptions(
+            remote=remote,
+            column_attrs=column_attrs,
+            exclude_row_attrs=exclude_row_attrs,
+            exclude_columns=exclude_columns,
+        )
+        return self.executor.execute(index, query, shards=shards, opt=opt)
+
+    def query_response(self, index: str, query: str, **kw) -> Dict[str, Any]:
+        """Query + serialize results to the JSON wire shape
+        (reference http/handler.go response encoding)."""
+        column_attrs = kw.get("column_attrs", False)
+        results = self.query(index, query, **kw)
+        out: Dict[str, Any] = {"results": [serialize_result(r) for r in results]}
+        if column_attrs:
+            cols = set()
+            for r in results:
+                if isinstance(r, Row):
+                    cols.update(int(c) for c in r.columns())
+            idx = self.holder.index(index)
+            attrs = []
+            for col in sorted(cols):
+                a = idx.column_attr_store.attrs(col)
+                if a:
+                    attrs.append({"id": col, "attrs": a})
+            out["columnAttrs"] = attrs
+        return out
+
+    # --------------------------------------------------------------- schema
+
+    def schema(self) -> List[dict]:
+        self._validate("schema")
+        return self.holder.schema()
+
+    def apply_schema(self, schema: List[dict]) -> None:
+        self.holder.apply_schema(schema)
+
+    def create_index(self, name: str, options: Optional[dict] = None) -> dict:
+        self._validate("create_index")
+        opts = IndexOptions.from_dict(options or {})
+        index = self.holder.create_index(name, opts)
+        self.server.broadcast_message({"type": "create-index", "index": name,
+                                       "options": opts.to_dict()})
+        return index.to_info()
+
+    def delete_index(self, name: str) -> None:
+        self._validate("delete_index")
+        self.holder.delete_index(name)
+        self.server.broadcast_message({"type": "delete-index", "index": name})
+
+    def create_field(self, index: str, name: str, options: Optional[dict] = None) -> dict:
+        self._validate("create_field")
+        idx = self.holder.index(index)
+        if idx is None:
+            from ..errors import IndexNotFoundError
+
+            raise IndexNotFoundError(index)
+        opts = FieldOptions.from_dict(options or {})
+        field = idx.create_field(name, opts)
+        self.server.broadcast_message({"type": "create-field", "index": index,
+                                       "field": name, "options": opts.to_dict()})
+        return field.to_info()
+
+    def delete_field(self, index: str, name: str) -> None:
+        self._validate("delete_field")
+        idx = self.holder.index(index)
+        if idx is None:
+            from ..errors import IndexNotFoundError
+
+            raise IndexNotFoundError(index)
+        idx.delete_field(name)
+        self.server.broadcast_message({"type": "delete-field", "index": index, "field": name})
+
+    # --------------------------------------------------------------- import
+
+    def import_bits(self, index: str, field: str, shard: int, row_ids, column_ids,
+                    timestamps=None, remote: bool = False) -> None:
+        """Route or apply a shard's worth of bits (api.go:653-698)."""
+        self._validate("import")
+        idx = self.holder.index(index)
+        if idx is None:
+            from ..errors import IndexNotFoundError
+
+            raise IndexNotFoundError(index)
+        fld = idx.field(field)
+        if fld is None:
+            from ..errors import FieldNotFoundError
+
+            raise FieldNotFoundError(field)
+
+        for node in self.cluster.shard_nodes(index, shard):
+            if node.id == self.cluster.node.id:
+                ts = None
+                if timestamps is not None and any(t is not None for t in timestamps):
+                    ts = [
+                        datetime.strptime(t, "%Y-%m-%dT%H:%M") if isinstance(t, str) else t
+                        for t in timestamps
+                    ]
+                fld.import_bits(row_ids, column_ids, ts)
+            elif not remote:
+                self.server.client.import_node(
+                    node, index, field, shard, row_ids, column_ids, timestamps
+                )
+
+    def import_values(self, index: str, field: str, shard: int, column_ids, values,
+                      remote: bool = False) -> None:
+        self._validate("import")
+        fld = self.holder.field(index, field)
+        if fld is None:
+            from ..errors import FieldNotFoundError
+
+            raise FieldNotFoundError(field)
+        for node in self.cluster.shard_nodes(index, shard):
+            if node.id == self.cluster.node.id:
+                fld.import_value(column_ids, values)
+            elif not remote:
+                self.server.client.import_value_node(
+                    node, index, field, shard, column_ids, values
+                )
+
+    # --------------------------------------------------------------- export
+
+    def export_csv(self, index: str, field: str, shard: int) -> str:
+        self._validate("export")
+        frag = self.holder.fragment(index, field, "standard", shard)
+        if frag is None:
+            from ..errors import FragmentNotFoundError
+
+            raise FragmentNotFoundError(f"{index}/{field}/standard/{shard}")
+        lines = []
+        for pos in frag.storage.slice():
+            row_id = int(pos) // SHARD_WIDTH
+            col_id = frag.shard * SHARD_WIDTH + int(pos) % SHARD_WIDTH
+            lines.append(f"{row_id},{col_id}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    # -------------------------------------------------------------- cluster
+
+    def status(self) -> dict:
+        return {
+            "state": self.cluster.state,
+            "nodes": [n.to_dict() for n in self.cluster.nodes],
+            "localID": self.cluster.node.id,
+        }
+
+    def info(self) -> dict:
+        return {"shardWidth": SHARD_WIDTH}
+
+    def shards_max(self) -> Dict[str, int]:
+        return {name: idx.max_shard() for name, idx in self.holder.indexes.items()}
+
+    def fragment_blocks(self, index: str, field: str, shard: int) -> List[dict]:
+        frag = self.holder.fragment(index, field, "standard", shard)
+        if frag is None:
+            from ..errors import FragmentNotFoundError
+
+            raise FragmentNotFoundError(f"{index}/{field}/{shard}")
+        return [b.to_dict() for b in frag.blocks()]
+
+    def fragment_block_data(self, index: str, field: str, view: str, shard: int, block: int) -> dict:
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            from ..errors import FragmentNotFoundError
+
+            raise FragmentNotFoundError(f"{index}/{field}/{view}/{shard}")
+        rows, cols = frag.block_data(block)
+        return {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()}
+
+    def cluster_message(self, msg: dict) -> None:
+        self._validate("cluster_message")
+        self.server.receive_message(msg)
+
+    def recalculate_caches(self) -> None:
+        for index in self.holder.indexes.values():
+            for field in index.fields.values():
+                for view in field.views.values():
+                    for frag in view.fragments.values():
+                        frag.cache.invalidate(force=True)
+        self.server.broadcast_message({"type": "recalculate-caches"})
+
+    def max_inverse_shards(self):  # parity stub: inverse views removed upstream
+        return {}
+
+    def set_coordinator(self, node_id: str) -> None:
+        self._validate("set_coordinator")
+        for n in self.cluster.nodes:
+            n.is_coordinator = n.id == node_id
+        self.server.broadcast_message({"type": "set-coordinator", "nodeID": node_id})
+
+    def remove_node(self, node_id: str) -> None:
+        self.cluster.remove_node(node_id)
+        self.server.broadcast_message({"type": "remove-node", "nodeID": node_id})
+
+    def translate_data(self, offset: int) -> bytes:
+        store = self.server.translate_store
+        return store.read_from(offset) if store else b""
+
+    def attr_diff(self, index: str, field: Optional[str], blocks: List[dict]) -> Dict[int, dict]:
+        """Return attrs for blocks whose checksums differ (api.go attr diff)."""
+        idx = self.holder.index(index)
+        if idx is None:
+            from ..errors import IndexNotFoundError
+
+            raise IndexNotFoundError(index)
+        if field:
+            fld = idx.field(field)
+            if fld is None:
+                from ..errors import FieldNotFoundError
+
+                raise FieldNotFoundError(field)
+            store = fld.row_attr_store
+        else:
+            store = idx.column_attr_store
+        remote = {b["id"]: bytes.fromhex(b["checksum"]) for b in blocks}
+        out: Dict[int, dict] = {}
+        for bid, chk in store.blocks():
+            if remote.get(bid) != chk:
+                out.update(store.block_data(bid))
+        return out
+
+
+def serialize_result(r) -> Any:
+    if isinstance(r, Row):
+        d = {"attrs": r.attrs or {}, "columns": [int(c) for c in r.columns()]}
+        if r.keys:
+            d["keys"] = r.keys
+        return d
+    if isinstance(r, ValCount):
+        return r.to_dict()
+    if isinstance(r, list) and (not r or isinstance(r[0], Pair)):
+        return [p.to_dict() for p in r]
+    if isinstance(r, (bool, int, float)) or r is None:
+        return r
+    return str(r)

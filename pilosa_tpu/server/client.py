@@ -1,0 +1,208 @@
+"""InternalClient: node-to-node HTTP operations.
+
+Port of the interface in /root/reference/client.go:34-60 and implementation
+http/client.go: query fan-out, import routing, fragment block diff, shard
+retrieval for resize, cluster message send, translate-log streaming.
+Uses stdlib urllib (JSON wire).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..errors import PilosaError
+from .handler import deserialize_remote
+
+
+class ClientError(PilosaError):
+    pass
+
+
+def _node_url(node) -> str:
+    uri = node.uri if not isinstance(node, str) else node
+    if not uri.startswith("http"):
+        uri = "http://" + uri
+    return uri.rstrip("/")
+
+
+class InternalClient:
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    def _request(self, method: str, url: str, body: Optional[bytes] = None,
+                 content_type: str = "application/json") -> bytes:
+        req = urllib.request.Request(url, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise ClientError(f"{method} {url}: {e.code} {detail}") from e
+        except urllib.error.URLError as e:
+            raise ClientError(f"{method} {url}: {e.reason}") from e
+
+    # ---------------------------------------------------------------- query
+
+    def query_node(self, node, index: str, query: str,
+                   shards: Optional[Sequence[int]] = None, remote: bool = True) -> List[Any]:
+        """Execute PQL on a peer restricted to its shards (http/client.go QueryNode)."""
+        params = {"remote": "true"} if remote else {}
+        url = f"{_node_url(node)}/index/{index}/query"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        body = json.dumps({"query": query, "shards": list(shards) if shards else None}).encode()
+        data = json.loads(self._request("POST", url, body))
+        if "error" in data:
+            raise ClientError(data["error"])
+        return [deserialize_remote(r) for r in data["results"]]
+
+    def query(self, host: str, index: str, query: str, **params) -> dict:
+        """Public query against a host; returns the raw JSON response."""
+        url = f"{_node_url(host)}/index/{index}/query"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        return json.loads(self._request("POST", url, query.encode(), "text/plain"))
+
+    # --------------------------------------------------------------- schema
+
+    def create_index(self, host, index: str, options: Optional[dict] = None) -> dict:
+        body = json.dumps({"options": options or {}}).encode()
+        return json.loads(self._request("POST", f"{_node_url(host)}/index/{index}", body))
+
+    def create_field(self, host, index: str, field: str, options: Optional[dict] = None) -> dict:
+        body = json.dumps({"options": options or {}}).encode()
+        return json.loads(
+            self._request("POST", f"{_node_url(host)}/index/{index}/field/{field}", body)
+        )
+
+    def ensure_index(self, host, index: str, options: Optional[dict] = None) -> None:
+        try:
+            self.create_index(host, index, options)
+        except ClientError as e:
+            if "exists" not in str(e).lower():
+                raise
+
+    def ensure_field(self, host, index: str, field: str, options: Optional[dict] = None) -> None:
+        try:
+            self.create_field(host, index, field, options)
+        except ClientError as e:
+            if "exists" not in str(e).lower():
+                raise
+
+    def schema(self, host) -> List[dict]:
+        return json.loads(self._request("GET", f"{_node_url(host)}/schema"))["indexes"]
+
+    def status(self, host) -> dict:
+        return json.loads(self._request("GET", f"{_node_url(host)}/status"))
+
+    def shards_max(self, host) -> Dict[str, int]:
+        return json.loads(self._request("GET", f"{_node_url(host)}/internal/shards/max"))["standard"]
+
+    # --------------------------------------------------------------- import
+
+    def import_node(self, node, index: str, field: str, shard: int,
+                    row_ids, column_ids, timestamps=None) -> None:
+        body = json.dumps({
+            "shard": shard,
+            "rowIDs": [int(r) for r in row_ids],
+            "columnIDs": [int(c) for c in column_ids],
+            "timestamps": timestamps,
+            "remote": True,
+        }).encode()
+        self._request("POST", f"{_node_url(node)}/index/{index}/field/{field}/import", body)
+
+    def import_value_node(self, node, index: str, field: str, shard: int,
+                          column_ids, values) -> None:
+        body = json.dumps({
+            "shard": shard,
+            "columnIDs": [int(c) for c in column_ids],
+            "values": [int(v) for v in values],
+            "remote": True,
+        }).encode()
+        self._request("POST", f"{_node_url(node)}/index/{index}/field/{field}/import", body)
+
+    def import_bits(self, host, index: str, field: str, bits) -> None:
+        """Public bulk import: group (row, col) bits by shard and POST each
+        group to an owning node (http/client.go:276 Import)."""
+        from ..constants import SHARD_WIDTH
+
+        by_shard: Dict[int, List] = {}
+        for bit in bits:
+            row, col = bit[0], bit[1]
+            ts = bit[2] if len(bit) > 2 else None
+            by_shard.setdefault(col // SHARD_WIDTH, []).append((row, col, ts))
+        for shard, group in sorted(by_shard.items()):
+            nodes = self.fragment_nodes(host, index, shard)
+            target = nodes[0]["uri"] if nodes else host
+            body = json.dumps({
+                "shard": shard,
+                "rowIDs": [b[0] for b in group],
+                "columnIDs": [b[1] for b in group],
+                "timestamps": [b[2] for b in group],
+            }).encode()
+            self._request("POST", f"{_node_url(target)}/index/{index}/field/{field}/import", body)
+
+    def import_values(self, host, index: str, field: str, field_values) -> None:
+        from ..constants import SHARD_WIDTH
+
+        by_shard: Dict[int, List] = {}
+        for col, val in field_values:
+            by_shard.setdefault(col // SHARD_WIDTH, []).append((col, val))
+        for shard, group in sorted(by_shard.items()):
+            nodes = self.fragment_nodes(host, index, shard)
+            target = nodes[0]["uri"] if nodes else host
+            body = json.dumps({
+                "shard": shard,
+                "columnIDs": [g[0] for g in group],
+                "values": [g[1] for g in group],
+            }).encode()
+            self._request("POST", f"{_node_url(target)}/index/{index}/field/{field}/import", body)
+
+    # ------------------------------------------------------------- internal
+
+    def fragment_nodes(self, host, index: str, shard: int) -> List[dict]:
+        url = f"{_node_url(host)}/internal/fragment/nodes?index={index}&shard={shard}"
+        return json.loads(self._request("GET", url))
+
+    def fragment_blocks(self, node, index: str, field: str, shard: int) -> List[dict]:
+        url = (f"{_node_url(node)}/internal/fragment/blocks?"
+               f"index={index}&field={field}&shard={shard}")
+        return json.loads(self._request("GET", url))["blocks"]
+
+    def block_data(self, node, index: str, field: str, view: str, shard: int, block: int) -> dict:
+        url = (f"{_node_url(node)}/internal/fragment/block/data?"
+               f"index={index}&field={field}&view={view}&shard={shard}&block={block}")
+        return json.loads(self._request("GET", url))
+
+    def retrieve_shard_from_uri(self, uri: str, index: str, field: str, view: str, shard: int) -> bytes:
+        url = (f"{_node_url(uri)}/internal/fragment/data?"
+               f"index={index}&field={field}&view={view}&shard={shard}")
+        return self._request("GET", url)
+
+    def send_fragment_data(self, node, index: str, field: str, view: str, shard: int, data: bytes) -> None:
+        url = (f"{_node_url(node)}/internal/fragment/data?"
+               f"index={index}&field={field}&view={view}&shard={shard}")
+        self._request("POST", url, data, "application/octet-stream")
+
+    def send_message(self, node, msg: dict) -> None:
+        body = json.dumps(msg).encode()
+        self._request("POST", f"{_node_url(node)}/internal/cluster/message", body)
+
+    def translate_data(self, node, offset: int) -> bytes:
+        url = f"{_node_url(node)}/internal/translate/data?offset={offset}"
+        return self._request("GET", url)
+
+    def attr_diff(self, node, index: str, field: Optional[str], blocks: List[dict]) -> Dict[int, dict]:
+        if field:
+            url = f"{_node_url(node)}/internal/index/{index}/field/{field}/attr/diff"
+        else:
+            url = f"{_node_url(node)}/internal/index/{index}/attr/diff"
+        data = json.loads(self._request("POST", url, json.dumps({"blocks": blocks}).encode()))
+        return {int(k): v for k, v in data["attrs"].items()}

@@ -1,0 +1,342 @@
+"""HTTP transport: stdlib ThreadingHTTPServer REST handler.
+
+Route table mirrors /root/reference/http/handler.go:189-231 (public
+/index//field//query/import/schema/status plus /internal/* node-to-node
+routes). Wire format is JSON (the reference negotiates JSON/protobuf;
+JSON is canonical here). Remote (node-to-node) query responses carry type
+tags so the coordinator can rehydrate Row/Pair/ValCount objects.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..core.cache import Pair
+from ..core.row import Row
+from ..errors import PilosaError
+from ..executor import ValCount
+from .api import API, serialize_result
+
+
+def serialize_remote(r) -> dict:
+    """Type-tagged result encoding for node-to-node responses."""
+    if isinstance(r, Row):
+        return {"type": "row", "columns": [int(c) for c in r.columns()],
+                "attrs": r.attrs or {}}
+    if isinstance(r, ValCount):
+        return {"type": "valcount", "value": r.val, "count": r.count}
+    if isinstance(r, list) and (not r or isinstance(r[0], Pair)):
+        return {"type": "pairs", "pairs": [p.to_dict() for p in r]}
+    if isinstance(r, bool):
+        return {"type": "bool", "value": r}
+    if isinstance(r, int):
+        return {"type": "uint64", "value": r}
+    return {"type": "none", "value": None}
+
+
+def deserialize_remote(d: dict):
+    t = d.get("type")
+    if t == "row":
+        row = Row(columns=d.get("columns", []))
+        row.attrs = d.get("attrs", {})
+        return row
+    if t == "valcount":
+        return ValCount(val=d["value"], count=d["count"])
+    if t == "pairs":
+        return [Pair(id=p["id"], count=p["count"], key=p.get("key", "")) for p in d["pairs"]]
+    if t in ("bool", "uint64"):
+        return d["value"]
+    return None
+
+
+class Route:
+    def __init__(self, method: str, pattern: str, fn: Callable):
+        self.method = method
+        self.regex = re.compile("^" + pattern + "$")
+        self.fn = fn
+
+
+class Handler:
+    """Routes HTTP requests to API methods."""
+
+    def __init__(self, api: API, logger=None):
+        self.api = api
+        self.logger = logger
+        self.routes: List[Route] = [
+            Route("GET", r"/", self.handle_home),
+            Route("GET", r"/index", self.handle_get_indexes),
+            Route("GET", r"/index/(?P<index>[^/]+)", self.handle_get_index),
+            Route("POST", r"/index/(?P<index>[^/]+)", self.handle_post_index),
+            Route("DELETE", r"/index/(?P<index>[^/]+)", self.handle_delete_index),
+            Route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)", self.handle_post_field),
+            Route("DELETE", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)", self.handle_delete_field),
+            Route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import", self.handle_post_import),
+            Route("POST", r"/index/(?P<index>[^/]+)/query", self.handle_post_query),
+            Route("GET", r"/export", self.handle_get_export),
+            Route("GET", r"/schema", self.handle_get_schema),
+            Route("GET", r"/status", self.handle_get_status),
+            Route("GET", r"/info", self.handle_get_info),
+            Route("GET", r"/version", self.handle_get_version),
+            Route("POST", r"/recalculate-caches", self.handle_recalculate_caches),
+            Route("POST", r"/cluster/resize/abort", self.handle_resize_abort),
+            Route("POST", r"/cluster/resize/remove-node", self.handle_remove_node),
+            Route("POST", r"/cluster/resize/set-coordinator", self.handle_set_coordinator),
+            Route("POST", r"/internal/cluster/message", self.handle_cluster_message),
+            Route("GET", r"/internal/fragment/blocks", self.handle_fragment_blocks),
+            Route("GET", r"/internal/fragment/block/data", self.handle_fragment_block_data),
+            Route("GET", r"/internal/fragment/nodes", self.handle_fragment_nodes),
+            Route("GET", r"/internal/fragment/data", self.handle_fragment_data),
+            Route("POST", r"/internal/fragment/data", self.handle_post_fragment_data),
+            Route("GET", r"/internal/shards/max", self.handle_shards_max),
+            Route("GET", r"/internal/translate/data", self.handle_translate_data),
+            Route("POST", r"/internal/index/(?P<index>[^/]+)/attr/diff", self.handle_index_attr_diff),
+            Route("POST", r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/attr/diff", self.handle_field_attr_diff),
+        ]
+
+    def dispatch(self, method: str, path: str, query: Dict[str, List[str]], body: bytes):
+        """Returns (status, content_type, payload_bytes)."""
+        for route in self.routes:
+            if route.method != method:
+                continue
+            m = route.regex.match(path)
+            if m is None:
+                continue
+            try:
+                start = time.monotonic()
+                result = route.fn(query=query, body=body, **m.groupdict())
+                elapsed = time.monotonic() - start
+                lqt = getattr(self.api.server, "long_query_time", 0)
+                if lqt and elapsed > lqt and self.logger:
+                    self.logger.info("%s %s %.3fs > long-query-time", method, path, elapsed)
+                if isinstance(result, tuple):
+                    return result
+                return 200, "application/json", json.dumps(result).encode()
+            except PilosaError as e:
+                return 400, "application/json", json.dumps({"error": str(e)}).encode()
+            except Exception as e:  # pragma: no cover - defensive
+                if self.logger:
+                    self.logger.error("handler error: %s", traceback.format_exc())
+                return 500, "application/json", json.dumps({"error": str(e)}).encode()
+        if path == "/index/" or re.match(r"^/index/[^/]+/query$", path):
+            return 405, "text/plain", b"method not allowed"
+        return 404, "application/json", json.dumps({"error": "not found"}).encode()
+
+    # ------------------------------------------------------------- handlers
+
+    def handle_home(self, **kw):
+        return {"message": "pilosa-tpu server. Send queries to /index/{index}/query"}
+
+    def handle_get_indexes(self, **kw):
+        return {"indexes": self.api.schema()}
+
+    def handle_get_schema(self, **kw):
+        return {"indexes": self.api.schema()}
+
+    def handle_get_index(self, index, **kw):
+        for info in self.api.schema():
+            if info["name"] == index:
+                return info
+        from ..errors import IndexNotFoundError
+
+        raise IndexNotFoundError(index)
+
+    def handle_post_index(self, index, body, **kw):
+        opts = json.loads(body or b"{}").get("options", {})
+        return self.api.create_index(index, opts)
+
+    def handle_delete_index(self, index, **kw):
+        self.api.delete_index(index)
+        return {}
+
+    def handle_post_field(self, index, field, body, **kw):
+        opts = json.loads(body or b"{}").get("options", {})
+        return self.api.create_field(index, field, opts)
+
+    def handle_delete_field(self, index, field, **kw):
+        self.api.delete_field(index, field)
+        return {}
+
+    def handle_post_import(self, index, field, body, **kw):
+        req = json.loads(body)
+        shard = req.get("shard", 0)
+        if "values" in req:
+            self.api.import_values(
+                index, field, shard, req["columnIDs"], req["values"],
+                remote=req.get("remote", False),
+            )
+        else:
+            self.api.import_bits(
+                index, field, shard, req["rowIDs"], req["columnIDs"],
+                req.get("timestamps"), remote=req.get("remote", False),
+            )
+        return {}
+
+    def handle_post_query(self, index, body, query, **kw):
+        body_text = body.decode() if body else ""
+        shards = None
+        remote = query.get("remote", ["false"])[0] == "true"
+        if body_text.startswith("{"):
+            req = json.loads(body_text)
+            pql = req.get("query", "")
+            shards = req.get("shards")
+        else:
+            pql = body_text
+        if "shards" in query:
+            shards = [int(s) for s in query["shards"][0].split(",")]
+        column_attrs = query.get("columnAttrs", ["false"])[0] == "true"
+        exclude_row_attrs = query.get("excludeRowAttrs", ["false"])[0] == "true"
+        exclude_columns = query.get("excludeColumns", ["false"])[0] == "true"
+        if remote:
+            results = self.api.query(index, pql, shards=shards, remote=True)
+            return {"results": [serialize_remote(r) for r in results]}
+        return self.api.query_response(
+            index, pql, shards=shards, column_attrs=column_attrs,
+            exclude_row_attrs=exclude_row_attrs, exclude_columns=exclude_columns,
+        )
+
+    def handle_get_export(self, query, **kw):
+        index = query["index"][0]
+        field = query["field"][0]
+        shard = int(query["shard"][0])
+        csv = self.api.export_csv(index, field, shard)
+        return 200, "text/csv", csv.encode()
+
+    def handle_get_status(self, **kw):
+        return self.api.status()
+
+    def handle_get_info(self, **kw):
+        return self.api.info()
+
+    def handle_get_version(self, **kw):
+        from .. import __version__
+
+        return {"version": __version__}
+
+    def handle_recalculate_caches(self, **kw):
+        self.api.recalculate_caches()
+        return {}
+
+    def handle_resize_abort(self, **kw):
+        self.api.server.resize_abort()
+        return {}
+
+    def handle_remove_node(self, body, **kw):
+        req = json.loads(body or b"{}")
+        self.api.remove_node(req.get("id", ""))
+        return {}
+
+    def handle_set_coordinator(self, body, **kw):
+        req = json.loads(body or b"{}")
+        self.api.set_coordinator(req.get("id", ""))
+        return {}
+
+    def handle_cluster_message(self, body, **kw):
+        self.api.cluster_message(json.loads(body))
+        return {}
+
+    def handle_fragment_blocks(self, query, **kw):
+        return {
+            "blocks": self.api.fragment_blocks(
+                query["index"][0], query["field"][0], int(query["shard"][0])
+            )
+        }
+
+    def handle_fragment_block_data(self, query, **kw):
+        return self.api.fragment_block_data(
+            query["index"][0], query["field"][0], query["view"][0],
+            int(query["shard"][0]), int(query["block"][0]),
+        )
+
+    def handle_fragment_nodes(self, query, **kw):
+        index = query["index"][0]
+        shard = int(query["shard"][0])
+        return [n.to_dict() for n in self.api.cluster.shard_nodes(index, shard)]
+
+    def handle_fragment_data(self, query, **kw):
+        """Stream a fragment's storage for shard relocation (resize)."""
+        import io
+
+        frag = self.api.holder.fragment(
+            query["index"][0], query["field"][0], query["view"][0], int(query["shard"][0])
+        )
+        if frag is None:
+            from ..errors import FragmentNotFoundError
+
+            raise FragmentNotFoundError("fragment not found")
+        buf = io.BytesIO()
+        frag.write_to(buf)
+        return 200, "application/octet-stream", buf.getvalue()
+
+    def handle_post_fragment_data(self, query, body, **kw):
+        import io
+
+        holder = self.api.holder
+        index, field = query["index"][0], query["field"][0]
+        view, shard = query["view"][0], int(query["shard"][0])
+        fld = holder.field(index, field)
+        v = fld.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(shard)
+        frag.read_from(io.BytesIO(body))
+        return {}
+
+    def handle_shards_max(self, **kw):
+        return {"standard": self.api.shards_max()}
+
+    def handle_translate_data(self, query, **kw):
+        offset = int(query.get("offset", ["0"])[0])
+        return 200, "application/octet-stream", self.api.translate_data(offset)
+
+    def handle_index_attr_diff(self, index, body, **kw):
+        req = json.loads(body)
+        attrs = self.api.attr_diff(index, None, req.get("blocks", []))
+        return {"attrs": {str(k): v for k, v in attrs.items()}}
+
+    def handle_field_attr_diff(self, index, field, body, **kw):
+        req = json.loads(body)
+        attrs = self.api.attr_diff(index, field, req.get("blocks", []))
+        return {"attrs": {str(k): v for k, v in attrs.items()}}
+
+
+class _RequestHandler(BaseHTTPRequestHandler):
+    handler: Handler = None  # set by serve()
+    protocol_version = "HTTP/1.1"
+
+    def _do(self, method: str):
+        parsed = urlparse(self.path)
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        status, ctype, payload = self.handler.dispatch(
+            method, parsed.path.rstrip("/") or "/", parse_qs(parsed.query), body
+        )
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        self._do("GET")
+
+    def do_POST(self):
+        self._do("POST")
+
+    def do_DELETE(self):
+        self._do("DELETE")
+
+    def log_message(self, fmt, *args):  # silence default stderr logging
+        pass
+
+
+def serve(handler: Handler, host: str = "localhost", port: int = 0) -> Tuple[ThreadingHTTPServer, threading.Thread, int]:
+    cls = type("BoundHandler", (_RequestHandler,), {"handler": handler})
+    httpd = ThreadingHTTPServer((host, port), cls)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd, thread, httpd.server_address[1]

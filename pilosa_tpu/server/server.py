@@ -1,0 +1,299 @@
+"""Server: node composition root (port of /root/reference/server.go).
+
+Owns holder, cluster, executor, translate store, HTTP handler and the
+background loops (anti-entropy, cache flush, runtime metrics). Cluster
+membership is static-by-config in this layer (the reference's `cluster.
+disabled` mode with explicit hosts, server.go OptServerClusterDisabled);
+coordinator-driven join/resize lives in cluster/resize.py.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ..cluster.node import Cluster, Node, STATE_NORMAL, STATE_RESIZING
+from ..core.holder import Holder
+from ..errors import PilosaError
+from ..executor import Executor
+from ..logger import Logger, NopLogger
+from ..stats import InMemoryStatsClient, NopStatsClient
+from ..translate import TranslateStore
+from .api import API
+from .client import ClientError, InternalClient
+from .handler import Handler, serve
+
+DEFAULT_ANTI_ENTROPY_INTERVAL = 600.0  # 10m (reference server/config.go:134)
+DEFAULT_CACHE_FLUSH_INTERVAL = 60.0  # 1m (reference holder.go:37)
+DEFAULT_METRIC_POLL_INTERVAL = 0.0  # disabled unless configured
+
+
+class Server:
+    def __init__(
+        self,
+        data_dir: Optional[str] = None,
+        host: str = "localhost",
+        port: int = 0,
+        node_id: Optional[str] = None,
+        cluster_hosts: Optional[List[str]] = None,
+        is_coordinator: bool = True,
+        replica_n: int = 1,
+        hasher=None,
+        anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL,
+        cache_flush_interval: float = DEFAULT_CACHE_FLUSH_INTERVAL,
+        metric_poll_interval: float = DEFAULT_METRIC_POLL_INTERVAL,
+        long_query_time: float = 0.0,
+        logger=None,
+        stats=None,
+        primary_translate_store_url: Optional[str] = None,
+        max_writes_per_request: int = 5000,
+        executor_workers: int = 8,
+    ):
+        self.data_dir = data_dir
+        self.host = host
+        self.port = port
+        self.logger = logger or NopLogger()
+        self.stats = stats or InMemoryStatsClient()
+        self.long_query_time = long_query_time
+        self.anti_entropy_interval = anti_entropy_interval
+        self.cache_flush_interval = cache_flush_interval
+        self.metric_poll_interval = metric_poll_interval
+        self.primary_translate_store_url = primary_translate_store_url
+
+        self.node_id = node_id or self._load_node_id()
+        self.node = Node(id=self.node_id, uri=f"{host}:{port}", is_coordinator=is_coordinator)
+        self.cluster = Cluster(
+            node=self.node, replica_n=replica_n, hasher=hasher
+        )
+        self._static_hosts = cluster_hosts or []
+
+        self.holder = Holder(
+            os.path.join(data_dir, "indexes") if data_dir else None,
+            stats=self.stats,
+            broadcast_shard=self._on_new_shard,
+        )
+        self.translate_store = TranslateStore(
+            os.path.join(data_dir, "keys") if data_dir else None,
+            read_only=primary_translate_store_url is not None,
+        )
+        self.client = InternalClient()
+        self.executor = Executor(
+            self.holder,
+            cluster=self.cluster,
+            client=self.client,
+            translate_store=self.translate_store,
+            max_writes_per_request=max_writes_per_request,
+            workers=executor_workers,
+        )
+        self.api = API(self)
+        self.handler = Handler(self.api, logger=self.logger)
+
+        self._httpd = None
+        self._http_thread = None
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.opened = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _load_node_id(self) -> str:
+        """Stable node id persisted in the data dir (reference holder.go:518)."""
+        if not self.data_dir:
+            return uuid.uuid4().hex[:12]
+        os.makedirs(self.data_dir, exist_ok=True)
+        id_path = os.path.join(self.data_dir, ".id")
+        if os.path.exists(id_path):
+            with open(id_path) as f:
+                return f.read().strip()
+        node_id = uuid.uuid4().hex[:12]
+        with open(id_path, "w") as f:
+            f.write(node_id)
+        return node_id
+
+    def open(self) -> "Server":
+        """Open sequence (reference server.go:311-357)."""
+        self.translate_store.open()
+        self._httpd, self._http_thread, actual_port = serve(
+            self.handler, self.host, self.port
+        )
+        self.port = actual_port
+        self.node.uri = f"{self.host}:{actual_port}"
+
+        # Static cluster membership: node list from config. Node identity
+        # must agree across peers without gossip, so in static mode the URI
+        # is the node id (reference `cluster.disabled` mode behaves the same
+        # way, cluster.go:1804+).
+        if self._static_hosts:
+            self.node.id = self.node.uri
+            self.node_id = self.node.uri
+            self.cluster.nodes = [self.node]
+            for host in self._static_hosts:
+                if host != self.node.uri:
+                    self.cluster.add_node(Node(id=host, uri=host))
+            self.cluster.nodes.sort(key=lambda n: n.id)
+
+        self.holder.open()
+        self.cluster.state = STATE_NORMAL
+
+        if self.anti_entropy_interval > 0 and self.cluster.replica_n > 1:
+            self._spawn(self._monitor_anti_entropy, self.anti_entropy_interval)
+        if self.cache_flush_interval > 0:
+            self._spawn(self._monitor_cache_flush, self.cache_flush_interval)
+        if self.metric_poll_interval > 0:
+            self._spawn(self._monitor_runtime, self.metric_poll_interval)
+        if self.primary_translate_store_url:
+            self._spawn(self._monitor_translate_replication, 1.0)
+        self.opened = True
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self.holder.close()
+        self.translate_store.close()
+        self.opened = False
+
+    def _spawn(self, fn, interval: float) -> None:
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    fn()
+                except Exception as e:  # pragma: no cover - monitor resilience
+                    self.logger.error("monitor error: %s", e)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # ---------------------------------------------------------- monitors
+
+    def _monitor_anti_entropy(self) -> None:
+        from ..cluster.syncer import HolderSyncer
+
+        start = time.monotonic()
+        self.stats.count("AntiEntropy", 1)
+        HolderSyncer(self).sync_holder()
+        self.stats.histogram("AntiEntropyDuration", (time.monotonic() - start) * 1000)
+
+    def _monitor_cache_flush(self) -> None:
+        self.holder.flush_caches()
+
+    def _monitor_runtime(self) -> None:
+        """Process gauges (reference server.go:655-697 monitorRuntime)."""
+        import resource
+
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        self.stats.gauge("maxRSS", usage.ru_maxrss)
+        self.stats.gauge("threads", threading.active_count())
+        try:
+            self.stats.gauge("openFiles", len(os.listdir("/proc/self/fd")))
+        except OSError:
+            pass
+
+    def _monitor_translate_replication(self) -> None:
+        data = self.client.translate_data(
+            self.primary_translate_store_url, self.translate_store.size()
+        )
+        if data:
+            self.translate_store.apply_log(data)
+
+    # ---------------------------------------------------------- messaging
+
+    def broadcast_message(self, msg: dict) -> None:
+        """Send a cluster message to every other node (broadcast.go SendSync)."""
+        for node in self.cluster.nodes:
+            if node.id == self.node.id:
+                continue
+            try:
+                self.client.send_message(node, msg)
+            except ClientError as e:
+                self.logger.error("broadcast to %s failed: %s", node.id, e)
+
+    def receive_message(self, msg: dict) -> None:
+        """Dispatch the 16 cluster message types (server.go:434-518)."""
+        from ..core.field import FieldOptions
+        from ..core.index import IndexOptions
+
+        typ = msg.get("type")
+        if typ == "create-index":
+            self.holder.create_index_if_not_exists(
+                msg["index"], IndexOptions.from_dict(msg.get("options", {}))
+            )
+        elif typ == "delete-index":
+            try:
+                self.holder.delete_index(msg["index"])
+            except PilosaError:
+                pass
+        elif typ == "create-field":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                idx.create_field_if_not_exists(
+                    msg["field"], FieldOptions.from_dict(msg.get("options", {}))
+                )
+        elif typ == "delete-field":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                try:
+                    idx.delete_field(msg["field"])
+                except PilosaError:
+                    pass
+        elif typ == "create-view":
+            fld = self.holder.field(msg["index"], msg["field"])
+            if fld is not None:
+                fld.create_view_if_not_exists(msg["view"])
+        elif typ == "delete-view":
+            fld = self.holder.field(msg["index"], msg["field"])
+            if fld is not None and msg["view"] in fld.views:
+                fld.views.pop(msg["view"]).close()
+        elif typ == "create-shard":
+            fld = self.holder.field(msg["index"], msg["field"])
+            if fld is not None:
+                view = fld.create_view_if_not_exists(msg.get("view", "standard"))
+                # broadcast=False: applying a peer's message must not echo it.
+                view.create_fragment_if_not_exists(msg["shard"], broadcast=False)
+        elif typ == "schema":
+            self.holder.apply_schema(msg["schema"])
+        elif typ == "cluster-status":
+            self.cluster.state = msg.get("state", self.cluster.state)
+            self.cluster.nodes = [Node.from_dict(n) for n in msg.get("nodes", [])]
+        elif typ == "set-coordinator":
+            for n in self.cluster.nodes:
+                n.is_coordinator = n.id == msg["nodeID"]
+        elif typ == "remove-node":
+            self.cluster.remove_node(msg["nodeID"])
+        elif typ == "recalculate-caches":
+            for index in self.holder.indexes.values():
+                for field in index.fields.values():
+                    for view in field.views.values():
+                        for frag in view.fragments.values():
+                            frag.cache.invalidate(force=True)
+        elif typ == "resize-instruction":
+            from ..cluster.resize import follow_resize_instruction
+
+            follow_resize_instruction(self, msg)
+        elif typ == "resize-complete":
+            from ..cluster.resize import mark_resize_instruction_complete
+
+            mark_resize_instruction_complete(self, msg)
+        elif typ == "node-state":
+            pass  # coordinator bookkeeping; static clusters are always NORMAL
+        else:
+            self.logger.error("unknown cluster message type: %s", typ)
+
+    def _on_new_shard(self, index: str, field: str, shard: int) -> None:
+        """View created a new shard fragment -> broadcast (view.go:210-257)."""
+        if self.opened:
+            self.broadcast_message(
+                {"type": "create-shard", "index": index, "field": field, "shard": shard}
+            )
+
+    def resize_abort(self) -> None:
+        if self.cluster.state == STATE_RESIZING:
+            self.cluster.state = STATE_NORMAL

@@ -1,0 +1,177 @@
+"""Metrics abstraction (port of /root/reference/stats.go).
+
+StatsClient interface: count/gauge/histogram/set/timing with tag scoping.
+Implementations: Nop, InMemory (expvar-equivalent, JSON-dumpable), Multi.
+A statsd/datadog emitter can be layered on InMemory via polling; the
+reference's datadog client (statsd/) maps to emit hooks here.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+class NopStatsClient:
+    def tags(self):
+        return []
+
+    def with_tags(self, *tags):
+        return self
+
+    def count(self, name, value, rate=1.0):
+        pass
+
+    def count_with_custom_tags(self, name, value, rate=1.0, tags=()):
+        pass
+
+    def gauge(self, name, value, rate=1.0):
+        pass
+
+    def histogram(self, name, value, rate=1.0):
+        pass
+
+    def set(self, name, value, rate=1.0):
+        pass
+
+    def timing(self, name, value, rate=1.0):
+        pass
+
+    def open(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class InMemoryStatsClient:
+    """Counter/gauge store, the expvar equivalent (stats.go:86-163)."""
+
+    def __init__(self, tags: Optional[List[str]] = None, _root=None):
+        self._tags = list(tags or [])
+        self._root = _root or self
+        if _root is None:
+            self.counters: Dict[str, float] = defaultdict(float)
+            self.gauges: Dict[str, float] = {}
+            self.timings: Dict[str, List[float]] = defaultdict(list)
+            self.sets: Dict[str, set] = defaultdict(set)
+            self._lock = threading.Lock()
+
+    def _key(self, name):
+        return f"{name}|{','.join(sorted(self._tags))}" if self._tags else name
+
+    def tags(self):
+        return list(self._tags)
+
+    def with_tags(self, *tags):
+        return InMemoryStatsClient(sorted(set(self._tags) | set(tags)), _root=self._root)
+
+    def count(self, name, value, rate=1.0):
+        root = self._root
+        with root._lock:
+            root.counters[self._key(name)] += value
+
+    def count_with_custom_tags(self, name, value, rate=1.0, tags=()):
+        key = f"{name}|{','.join(sorted(set(self._tags) | set(tags)))}"
+        root = self._root
+        with root._lock:
+            root.counters[key] += value
+
+    def gauge(self, name, value, rate=1.0):
+        root = self._root
+        with root._lock:
+            root.gauges[self._key(name)] = value
+
+    def histogram(self, name, value, rate=1.0):
+        root = self._root
+        with root._lock:
+            root.timings[self._key(name)].append(value)
+
+    def set(self, name, value, rate=1.0):
+        root = self._root
+        with root._lock:
+            root.sets[self._key(name)].add(value)
+
+    def timing(self, name, value, rate=1.0):
+        self.histogram(name, value, rate)
+
+    def snapshot(self) -> dict:
+        root = self._root
+        with root._lock:
+            return {
+                "counters": dict(root.counters),
+                "gauges": dict(root.gauges),
+                "timings": {k: list(v) for k, v in root.timings.items()},
+                "sets": {k: sorted(map(str, v)) for k, v in root.sets.items()},
+            }
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot())
+
+    def open(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class MultiStatsClient:
+    def __init__(self, clients):
+        self.clients = list(clients)
+
+    def tags(self):
+        return self.clients[0].tags() if self.clients else []
+
+    def with_tags(self, *tags):
+        return MultiStatsClient([c.with_tags(*tags) for c in self.clients])
+
+    def count(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.count(name, value, rate)
+
+    def count_with_custom_tags(self, name, value, rate=1.0, tags=()):
+        for c in self.clients:
+            c.count_with_custom_tags(name, value, rate, tags)
+
+    def gauge(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.gauge(name, value, rate)
+
+    def histogram(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.histogram(name, value, rate)
+
+    def set(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.set(name, value, rate)
+
+    def timing(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.timing(name, value, rate)
+
+    def open(self):
+        for c in self.clients:
+            c.open()
+
+    def close(self):
+        for c in self.clients:
+            c.close()
+
+
+class Timer:
+    """Context manager feeding a stats histogram in milliseconds."""
+
+    def __init__(self, stats, name):
+        self.stats = stats
+        self.name = name
+
+    def __enter__(self):
+        self.start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        if self.stats:
+            self.stats.timing(self.name, (time.monotonic() - self.start) * 1000.0)
