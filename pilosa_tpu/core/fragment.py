@@ -111,6 +111,9 @@ class Fragment:
         self._plane_cache: Dict[int, jnp.ndarray] = {}
         self._checksums: Dict[int, bytes] = {}
         self._opened = False
+        # Bumped on every mutation; lets the sharded query engine know when
+        # its device-resident leaf tensors are stale (parallel/engine.py).
+        self.generation = 0
 
     # ---------------------------------------------------------------- open
 
@@ -162,6 +165,14 @@ class Fragment:
         self._plane_cache[row_id] = p
         return p
 
+    def plane_np(self, row_id: int) -> np.ndarray:
+        """Host numpy bitplane for one row (for batched sharded assembly)."""
+        start = row_id * SHARD_WIDTH
+        local = (self.storage.slice_range(start, start + SHARD_WIDTH) - np.uint64(start)).astype(
+            np.uint32
+        )
+        return bp.pack_bits(local)
+
     def plane_stack(self, row_ids: Sequence[int]) -> jnp.ndarray:
         return jnp.stack([self.plane(r) for r in row_ids])
 
@@ -185,6 +196,7 @@ class Fragment:
     def _invalidate_row(self, row_id: int) -> None:
         self._plane_cache.pop(row_id, None)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+        self.generation += 1
 
     def set_bit(self, row_id: int, column_id: int) -> bool:
         pos = self.pos(row_id, column_id)

@@ -87,6 +87,28 @@ class Executor:
         self.translate_store = translate_store
         self.max_writes_per_request = max_writes_per_request
         self._pool = ThreadPoolExecutor(max_workers=workers) if workers > 1 else None
+        self._engine = None  # lazy ShardedQueryEngine
+
+    @property
+    def engine(self):
+        if self._engine is None:
+            from .parallel.engine import ShardedQueryEngine
+
+            self._engine = ShardedQueryEngine(self.holder)
+        return self._engine
+
+    def _partition_shards(self, index: str, shards: List[int]):
+        """Split shards into locally-owned vs per-remote-node groups."""
+        local: List[int] = []
+        remote: Dict[str, List[int]] = {}
+        for shard in shards:
+            nodes = self.cluster.shard_nodes(index, shard)
+            owner = next((n for n in nodes if n.id == self.node.id), nodes[0])
+            if owner.id == self.node.id:
+                local.append(shard)
+            else:
+                remote.setdefault(owner.id, []).append(shard)
+        return local, remote
 
     @property
     def node(self):
@@ -200,7 +222,11 @@ class Executor:
             prev.merge(v)
             return prev
 
-        row = self._map_reduce(index, shards, c, opt, map_fn, reduce_fn) or Row()
+        row = self._batched_or_map_reduce(
+            index, c, shards, opt, "bitmap", map_fn, reduce_fn
+        )
+        if row is None:
+            row = Row()
 
         if c.name == "Row" and not opt.exclude_row_attrs:
             idx = self.holder.index(index)
@@ -342,8 +368,34 @@ class Executor:
         def map_fn(shard):
             return self._execute_bitmap_call_shard(index, child, shard).count()
 
-        result = self._map_reduce(index, shards, c, opt, map_fn, lambda a, b: a + b)
+        result = self._batched_or_map_reduce(
+            index, c, shards, opt, "count", map_fn, lambda a, b: a + b, child=child
+        )
         return int(result or 0)
+
+    def _batched_or_map_reduce(self, index, c, shards, opt, kind, map_fn, reduce_fn, child=None):
+        """Run locally-owned shards as ONE sharded device program when the
+        call tree compiles onto the fast path; remote/unsupported shards use
+        the reference-style per-shard map/reduce."""
+        target = child if child is not None else c
+        if shards and self.engine.supports(target):
+            local, remote = self._partition_shards(index, shards)
+            result = None
+            if local:
+                if kind == "count":
+                    result = self.engine.count(index, target, local)
+                else:
+                    result = self.engine.bitmap(index, target, local)
+            for node_id, node_shards in remote.items():
+                if opt.remote:
+                    continue
+                node = self.cluster.node_by_id(node_id)
+                v = self.client.query_node(
+                    node, index, str(c), shards=node_shards, remote=True
+                )[0]
+                result = v if result is None else reduce_fn(result, v)
+            return result
+        return self._map_reduce(index, shards, c, opt, map_fn, reduce_fn)
 
     # --------------------------------------------------------- sum/min/max
 

@@ -1,0 +1,294 @@
+"""Sharded query engine: one XLA program per query shape over all shards.
+
+This replaces the reference's goroutine-per-shard map loop
+(executor.go:1558-1593) for local shards. A PQL bitmap call tree is
+compiled once per *structure* into a jitted function over a stacked leaf
+tensor of shape (L, S, W) — L leaf rows, S shards sharded over the device
+mesh, W bitplane words. XLA fuses the whole tree into one fused
+elementwise+popcount kernel per device and inserts ICI collectives for the
+scalar reductions. Leaf planes are cached on device between queries and
+invalidated by fragment generation counters.
+
+Supported fast-path calls: Row / Intersect / Union / Difference / Xor /
+Range(BSI) compositions, Count(...) and per-row TopN candidate counting.
+Everything else falls back to the executor's per-shard path.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import SHARD_WIDTH, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD, WORDS_PER_ROW
+from ..core.row import Row
+from ..errors import FieldNotFoundError, BSIGroupNotFoundError, QueryError
+from ..ops import bitplane as bp
+from ..pql.ast import BETWEEN, Call, Condition, GT, GTE, LT, LTE, NEQ
+from .mesh import SHARD_AXIS, default_mesh, pad_shards, replicated, shard_sharding
+
+
+@dataclass(frozen=True)
+class Leaf:
+    """A fragment row that must be materialized on device."""
+
+    field: str
+    view: str
+    row: int
+
+
+class _Compiler:
+    """AST -> (leaves, expression builder). The builder is pure jnp over a
+    (L, S, W) leaf tensor, so the jitted program is cacheable per structure
+    signature (predicates are baked in and included in the signature)."""
+
+    def __init__(self, holder, index: str):
+        self.holder = holder
+        self.index = index
+        self.leaves: List[Leaf] = []
+        self.signature: List = []
+
+    def leaf_index(self, leaf: Leaf) -> int:
+        try:
+            return self.leaves.index(leaf)
+        except ValueError:
+            self.leaves.append(leaf)
+            return len(self.leaves) - 1
+
+    def compile(self, c: Call) -> Callable:
+        if c.name == "Row":
+            field_name = c.field_arg()
+            if self.holder.field(self.index, field_name) is None:
+                raise FieldNotFoundError(field_name)
+            row_id, ok = c.uint_arg(field_name)
+            if not ok:
+                raise QueryError("Row() must specify row")
+            i = self.leaf_index(Leaf(field_name, VIEW_STANDARD, row_id))
+            self.signature.append(("row", i))
+            return lambda leaves: leaves[i]
+        if c.name in ("Intersect", "Union", "Difference", "Xor"):
+            if not c.children:
+                raise QueryError(f"empty {c.name} query is currently not supported")
+            subs = [self.compile(ch) for ch in c.children]
+            op = {
+                "Intersect": jnp.bitwise_and,
+                "Union": jnp.bitwise_or,
+                "Difference": lambda a, b: jnp.bitwise_and(a, jnp.bitwise_not(b)),
+                "Xor": jnp.bitwise_xor,
+            }[c.name]
+            self.signature.append((c.name, len(c.children)))
+
+            def fn(leaves, subs=subs, op=op):
+                out = subs[0](leaves)
+                for s in subs[1:]:
+                    out = op(out, s(leaves))
+                return out
+
+            return fn
+        if c.name == "Range" and c.has_condition_arg():
+            return self._compile_bsi_range(c)
+        raise QueryError(f"not fast-path compilable: {c.name}")
+
+    def _compile_bsi_range(self, c: Call) -> Callable:
+        (field_name, cond), = c.args.items()
+        fld = self.holder.field(self.index, field_name)
+        if fld is None:
+            raise FieldNotFoundError(field_name)
+        bsig = fld.bsi_group(field_name)
+        if bsig is None:
+            raise BSIGroupNotFoundError(field_name)
+        depth = bsig.bit_depth()
+        view = VIEW_BSI_GROUP_PREFIX + field_name
+        idxs = [self.leaf_index(Leaf(field_name, view, i)) for i in range(depth + 1)]
+
+        zero_fn = lambda leaves: jnp.zeros_like(leaves[0])
+        not_null = lambda leaves: leaves[idxs[depth]]
+
+        if cond.op == NEQ and cond.value is None:
+            self.signature.append(("notnull", field_name))
+            return not_null
+
+        if cond.op == BETWEEN:
+            predicates = cond.int_slice_value()
+            lo, hi, out_of_range = bsig.base_value_between(*predicates)
+            self.signature.append(("between", field_name, lo, hi, out_of_range))
+            if out_of_range:
+                return zero_fn
+            if predicates[0] <= bsig.min and predicates[1] >= bsig.max:
+                return not_null
+            return lambda leaves: bp.bsi_range_between(
+                jnp.stack([leaves[i] for i in idxs]), depth, lo, hi
+            )
+
+        value = cond.value
+        if not isinstance(value, int) or isinstance(value, bool):
+            raise QueryError("Range(): conditions only support integer values")
+        base, out_of_range = bsig.base_value(cond.op, value)
+        self.signature.append((cond.op, field_name, base, out_of_range, value))
+        if out_of_range and cond.op != NEQ:
+            return zero_fn
+        if (
+            (cond.op == LT and value > bsig.max)
+            or (cond.op == LTE and value >= bsig.max)
+            or (cond.op == GT and value < bsig.min)
+            or (cond.op == GTE and value <= bsig.min)
+            or (out_of_range and cond.op == NEQ)
+        ):
+            return not_null
+
+        def fn(leaves):
+            planes = jnp.stack([leaves[i] for i in idxs])
+            if cond.op == "eq":
+                return bp.bsi_range_eq(planes, depth, base)
+            if cond.op == "neq":
+                return bp.bsi_range_neq(planes, depth, base)
+            if cond.op in ("lt", "lte"):
+                return bp.bsi_range_lt(planes, depth, base, cond.op == "lte")
+            return bp.bsi_range_gt(planes, depth, base, cond.op == "gte")
+
+        return fn
+
+
+class ShardedQueryEngine:
+    def __init__(self, holder, mesh=None):
+        self.holder = holder
+        self.mesh = mesh if mesh is not None else default_mesh()
+        # (index, leaf, shards) -> (generation fingerprint, sharded device array)
+        self._leaf_cache: Dict[Tuple, Tuple[Tuple, jax.Array]] = {}
+        self._count_fns: Dict[Tuple, Callable] = {}
+        self._bitmap_fns: Dict[Tuple, Callable] = {}
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    # --------------------------------------------------------- leaf tensors
+
+    def _gather_leaf(self, index: str, leaf: Leaf, shards: Tuple[int, ...]) -> jax.Array:
+        """(S_padded, W) uint32, sharded over the mesh's shard axis."""
+        s_padded = pad_shards(len(shards), self.n_devices)
+        key = (index, leaf, shards)
+        frags = [
+            self.holder.fragment(index, leaf.field, leaf.view, s) for s in shards
+        ]
+        fingerprint = tuple(-1 if f is None else f.generation for f in frags)
+        cached = self._leaf_cache.get(key)
+        if cached is not None and cached[0] == fingerprint:
+            return cached[1]
+        buf = np.zeros((s_padded, WORDS_PER_ROW), dtype=np.uint32)
+        for i, frag in enumerate(frags):
+            if frag is not None:
+                buf[i] = frag.plane_np(leaf.row)
+        arr = jax.device_put(buf, shard_sharding(self.mesh, 2))
+        self._leaf_cache[key] = (fingerprint, arr)
+        return arr
+
+    def _leaf_tensor(self, index: str, leaves: List[Leaf], shards: Tuple[int, ...]):
+        """Tuple of per-leaf (S, W) sharded arrays. Passed as a pytree into
+        jitted query fns so each input keeps its NamedSharding (stacking
+        outside jit would re-lay-out the data)."""
+        return tuple(self._gather_leaf(index, leaf, shards) for leaf in leaves)
+
+    # -------------------------------------------------------------- queries
+
+    def _compile(self, index: str, call: Call):
+        comp = _Compiler(self.holder, index)
+        expr = comp.compile(call)
+        return comp, expr
+
+    def count(self, index: str, call: Call, shards: Sequence[int]) -> int:
+        """Count(<bitmap call>) over all shards in one device program."""
+        shards = tuple(shards)
+        comp, expr = self._compile(index, call)
+        sig = ("count", tuple(comp.signature), len(shards))
+        fn = self._count_fns.get(sig)
+        if fn is None:
+            @jax.jit
+            def fn(leaves):
+                plane = expr(leaves)
+                # XLA turns the full-tensor sum over the sharded axis into
+                # per-device partial popcounts + an ICI all-reduce.
+                return jnp.sum(jax.lax.population_count(plane).astype(jnp.int32))
+
+            self._count_fns[sig] = fn
+        leaves = self._leaf_tensor(index, comp.leaves, shards)
+        return int(fn(leaves))
+
+    def bitmap(self, index: str, call: Call, shards: Sequence[int]) -> Row:
+        """Evaluate a bitmap call over all shards; returns a Row whose
+        segments stay on device (one (W,) plane per shard)."""
+        shards = tuple(shards)
+        comp, expr = self._compile(index, call)
+        sig = ("bitmap", tuple(comp.signature), len(shards))
+        fn = self._bitmap_fns.get(sig)
+        if fn is None:
+            fn = jax.jit(expr)
+            self._bitmap_fns[sig] = fn
+        leaves = self._leaf_tensor(index, comp.leaves, shards)
+        planes = fn(leaves)  # (S_padded, W) sharded
+        return Row({shard: planes[i] for i, shard in enumerate(shards)})
+
+    def topn_counts(
+        self, index: str, field: str, row_ids: Sequence[int],
+        shards: Sequence[int], src_call: Optional[Call] = None,
+    ) -> np.ndarray:
+        """Total per-row counts across shards (optionally ∩ src bitmap) in
+        one batched program — the distributed TopN inner loop."""
+        shards = tuple(shards)
+        leaves = [Leaf(field, VIEW_STANDARD, r) for r in row_ids]
+        rows_tensor = self._leaf_tensor(index, leaves, shards)  # (R, S, W)
+        if src_call is not None:
+            comp, expr = self._compile(index, src_call)
+            src_leaves = self._leaf_tensor(index, comp.leaves, shards)
+            sig = ("topn_src", tuple(comp.signature), len(shards), len(row_ids))
+            fn = self._count_fns.get(sig)
+            if fn is None:
+                @jax.jit
+                def fn(rows, src_lv):
+                    src = expr(src_lv)  # (S, W)
+                    stacked = jnp.stack(rows)
+                    masked = jnp.bitwise_and(stacked, src[None, :, :])
+                    return jnp.sum(
+                        jax.lax.population_count(masked).astype(jnp.int32), axis=(1, 2)
+                    )
+
+                self._count_fns[sig] = fn
+            return np.asarray(fn(rows_tensor, src_leaves))
+
+        sig = ("topn", len(shards), len(row_ids))
+        fn = self._count_fns.get(sig)
+        if fn is None:
+            @jax.jit
+            def fn(rows):
+                stacked = jnp.stack(rows)
+                return jnp.sum(
+                    jax.lax.population_count(stacked).astype(jnp.int32), axis=(1, 2)
+                )
+
+            self._count_fns[sig] = fn
+        return np.asarray(fn(rows_tensor))
+
+    def supports(self, call: Call) -> bool:
+        """True if `call` compiles onto the fast path."""
+        try:
+            self._compile_check(call)
+            return True
+        except Exception:
+            return False
+
+    def _compile_check(self, call: Call) -> None:
+        if call.name == "Row":
+            return
+        if call.name in ("Intersect", "Union", "Difference", "Xor"):
+            if not call.children:
+                raise QueryError("empty")
+            for ch in call.children:
+                self._compile_check(ch)
+            return
+        if call.name == "Range" and call.has_condition_arg():
+            return
+        raise QueryError(f"not fast-path: {call.name}")

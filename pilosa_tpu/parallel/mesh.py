@@ -1,0 +1,53 @@
+"""Device mesh + shard placement for data-parallel query execution.
+
+The reference's parallelism is data parallelism over 2^20-column shards
+(SURVEY.md §2: executor.go:1464-1593 goroutine-per-shard + scatter-gather
+RPC). The TPU-native equivalent: shards are laid out along a 1-D 'shards'
+mesh axis; per-shard bitplane kernels run on every device in SPMD and
+scalar reductions (Count/Sum/TopN candidate counts) ride ICI collectives
+inserted by XLA (or explicit psum under shard_map).
+
+Pipeline/tensor/sequence/expert parallelism have no analog in a bitmap
+index (SURVEY.md §2 records their absence in the reference); the mesh is
+deliberately 1-D.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shards"
+
+
+def default_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (SHARD_AXIS,))
+
+
+def shard_sharding(mesh: Mesh, ndim: int, axis: int = 0) -> NamedSharding:
+    """NamedSharding splitting dimension `axis` over the shard mesh axis."""
+    spec = [None] * ndim
+    spec[axis] = SHARD_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_shards(n_shards: int, n_devices: int) -> int:
+    """Number of shard slots after padding to a device multiple."""
+    if n_shards % n_devices == 0:
+        return n_shards
+    return ((n_shards // n_devices) + 1) * n_devices
+
+
+def device_for_shard(shard_index: int, n_shards_padded: int, n_devices: int) -> int:
+    """Block placement: contiguous runs of shards per device (matches the
+    default NamedSharding block layout over the leading axis)."""
+    per = n_shards_padded // n_devices
+    return shard_index // per
