@@ -1,0 +1,123 @@
+"""Benchmark: PQL Count(Intersect) + TopN throughput on device vs host.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "...", "vs_baseline": N}
+
+The workload is BASELINE.md's north-star shape scaled to one chip: a
+multi-shard index, Count(Intersect(Row,Row)) and TopN served from the
+sharded device engine. vs_baseline compares against the same queries
+executed with CPU bitmap ops (the host roaring-container path — the moral
+equivalent of the reference's Go hot loop, which is also CPU bitmap math),
+measured in this same process. >1.0 means the device path is faster.
+
+Env knobs: BENCH_SHARDS (default 8), BENCH_ROWS (default 32),
+BENCH_DENSITY (default 0.02), BENCH_ITERS (default 128).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def build(n_shards, n_rows, density):
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+
+    holder = Holder(None)
+    holder.open()
+    idx = holder.create_index("bench")
+    fld = idx.create_field("f")
+    rng = np.random.default_rng(42)
+    bits_per_row_shard = int(SHARD_WIDTH * density)
+    all_rows, all_cols = [], []
+    for row in range(n_rows):
+        for shard in range(n_shards):
+            cols = rng.choice(SHARD_WIDTH, size=bits_per_row_shard, replace=False)
+            all_rows.append(np.full(bits_per_row_shard, row, dtype=np.uint64))
+            all_cols.append(cols.astype(np.uint64) + np.uint64(shard * SHARD_WIDTH))
+    fld.import_bits(np.concatenate(all_rows), np.concatenate(all_cols))
+    return holder, Executor(holder, workers=0)
+
+
+def bench_device(ex, n_rows, n_shards, iters):
+    from pilosa_tpu.pql.parser import parse
+
+    engine = ex.engine
+    shards = list(range(n_shards))
+    calls = [
+        parse(f"Count(Intersect(Row(f={i % n_rows}), Row(f={(i + 1) % n_rows})))").calls[0].children[0]
+        for i in range(iters)
+    ]
+    # Warmup: compile the batch program + populate the device leaf cache.
+    engine.count_batch("bench", calls, shards)
+    ex.execute("bench", "TopN(f, n=5)")
+
+    start = time.perf_counter()
+    engine.count_batch("bench", calls, shards)
+    count_qps = iters / (time.perf_counter() - start)
+
+    start = time.perf_counter()
+    topn_iters = max(3, iters // 4)
+    for _ in range(topn_iters):
+        ex.execute("bench", "TopN(f, n=5)")
+    topn_qps = topn_iters / (time.perf_counter() - start)
+    return count_qps, topn_qps
+
+
+def bench_host(holder, n_rows, n_shards, iters):
+    """Same Count(Intersect) math with CPU container ops (baseline)."""
+    frags = [
+        holder.fragment("bench", "f", "standard", s) for s in range(n_shards)
+    ]
+    from pilosa_tpu.constants import SHARD_WIDTH
+
+    def host_row(frag, row):
+        start = row * SHARD_WIDTH
+        return frag.storage.slice_range(start, start + SHARD_WIDTH)
+
+    # Pre-extract per-shard row arrays (favors the baseline: no extraction
+    # cost inside the timed loop).
+    cache = {}
+    for row in range(n_rows):
+        cache[row] = [host_row(f, row) for f in frags]
+
+    host_iters = max(3, iters // 3)
+    start = time.perf_counter()
+    for i in range(host_iters):
+        a, b = i % n_rows, (i + 1) % n_rows
+        total = 0
+        for sa, sb in zip(cache[a], cache[b]):
+            total += len(np.intersect1d(sa, sb, assume_unique=True))
+    return host_iters / (time.perf_counter() - start)
+
+
+def main():
+    n_shards = int(os.environ.get("BENCH_SHARDS", "8"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "32"))
+    density = float(os.environ.get("BENCH_DENSITY", "0.02"))
+    iters = int(os.environ.get("BENCH_ITERS", "128"))
+
+    holder, ex = build(n_shards, n_rows, density)
+    count_qps, topn_qps = bench_device(ex, n_rows, n_shards, iters)
+    host_qps = bench_host(holder, n_rows, n_shards, iters)
+
+    print(json.dumps({
+        "metric": "count_intersect_qps_8shards",
+        "value": round(count_qps, 2),
+        "unit": "queries/sec",
+        "vs_baseline": round(count_qps / host_qps, 3),
+        "detail": {
+            "topn_qps": round(topn_qps, 2),
+            "host_cpu_qps": round(host_qps, 2),
+            "shards": n_shards,
+            "rows": n_rows,
+            "density": density,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

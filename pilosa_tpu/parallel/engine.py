@@ -218,6 +218,56 @@ class ShardedQueryEngine:
         leaves = self._leaf_tensor(index, comp.leaves, shards)
         return int(fn(leaves))
 
+    def count_async(self, index: str, call: Call, shards: Sequence[int]):
+        """Like count() but returns the unmaterialized device scalar, so
+        callers can pipeline many queries before blocking (dispatch latency
+        through the host<->device link dominates single-query serving)."""
+        shards = tuple(shards)
+        comp, expr = self._compile(index, call)
+        sig = ("count", tuple(comp.signature), len(shards))
+        fn = self._count_fns.get(sig)
+        if fn is None:
+            @jax.jit
+            def fn(leaves):
+                plane = expr(leaves)
+                return jnp.sum(jax.lax.population_count(plane).astype(jnp.int32))
+
+            self._count_fns[sig] = fn
+        return fn(self._leaf_tensor(index, comp.leaves, shards))
+
+    def count_batch(self, index: str, calls: Sequence[Call], shards: Sequence[int]) -> np.ndarray:
+        """Count Q structurally-identical queries in ONE device program.
+
+        Every bitplane op is elementwise, so the compiled expression applies
+        unchanged to each query's leaf set; XLA fuses the whole batch and the
+        host pays one dispatch + one transfer for Q results. This is the
+        throughput-serving path (amortizes host<->device latency that caps
+        per-call serving at ~1/RTT)."""
+        shards = tuple(shards)
+        comps = [self._compile(index, c) for c in calls]
+        sig0 = tuple(comps[0][0].signature)
+        for comp, _ in comps[1:]:
+            if tuple(comp.signature) != sig0:
+                raise QueryError("count_batch requires structurally identical queries")
+        sig = ("count_batch", sig0, len(shards), len(calls))
+        fn = self._count_fns.get(sig)
+        if fn is None:
+            exprs = [e for _, e in comps]
+
+            @jax.jit
+            def fn(leavess):
+                outs = []
+                for lv, e in zip(leavess, exprs):
+                    plane = e(lv)
+                    outs.append(jnp.sum(jax.lax.population_count(plane).astype(jnp.int32)))
+                return jnp.stack(outs)
+
+            self._count_fns[sig] = fn
+        leavess = tuple(
+            self._leaf_tensor(index, comp.leaves, shards) for comp, _ in comps
+        )
+        return np.asarray(fn(leavess))
+
     def bitmap(self, index: str, call: Call, shards: Sequence[int]) -> Row:
         """Evaluate a bitmap call over all shards; returns a Row whose
         segments stay on device (one (W,) plane per shard)."""
