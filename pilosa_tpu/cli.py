@@ -1,0 +1,250 @@
+"""CLI: server / import / export / inspect / check / config / generate-config.
+
+Port of the reference's cobra command tree (cmd/root.go:32-87, ctl/) on
+argparse. Config precedence: flags > PILOSA_TPU_* env > TOML file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import signal
+import sys
+import time
+from typing import List, Optional
+
+from .config import Config
+from .errors import PilosaError
+
+
+def _add_config_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", help="path to TOML config file")
+    p.add_argument("--data-dir", dest="data_dir")
+    p.add_argument("--bind")
+    p.add_argument("--max-writes-per-request", dest="max_writes_per_request", type=int)
+    p.add_argument("--verbose", action="store_const", const=True, default=None)
+    p.add_argument("--cluster-hosts", dest="cluster_hosts",
+                   type=lambda s: [h.strip() for h in s.split(",") if h.strip()])
+    p.add_argument("--cluster-replicas", dest="cluster_replicas", type=int)
+    p.add_argument("--long-query-time", dest="long_query_time", type=float)
+    p.add_argument("--anti-entropy-interval", dest="anti_entropy_interval", type=float)
+    p.add_argument("--translation-primary-url", dest="translation_primary_url")
+
+
+def _load_config(args) -> Config:
+    flags = {k: v for k, v in vars(args).items() if v is not None}
+    return Config.load(getattr(args, "config", None), flags)
+
+
+def cmd_server(args) -> int:
+    from .logger import Logger
+
+    cfg = _load_config(args)
+    server = cfg.build_server(logger=Logger(verbose=cfg.verbose))
+    server.open()
+    print(f"pilosa-tpu server listening on http://{server.node.uri}", flush=True)
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        server.close()
+    return 0
+
+
+def cmd_import(args) -> int:
+    from .server.client import InternalClient
+
+    client = InternalClient()
+    if args.create:
+        client.ensure_index(args.host, args.index, {"keys": args.index_keys})
+        field_opts = {
+            "type": args.field_type,
+            "cacheType": args.field_cache_type,
+            "cacheSize": args.field_cache_size,
+            "keys": args.field_keys,
+        }
+        if args.field_type == "int":
+            field_opts["min"] = args.field_min
+            field_opts["max"] = args.field_max
+        if args.field_time_quantum:
+            field_opts["type"] = "time"
+            field_opts["timeQuantum"] = args.field_time_quantum
+        client.create_field(args.host, args.index, args.field, field_opts)
+
+    total = 0
+    for path in args.paths:
+        fh = sys.stdin if path == "-" else open(path)
+        try:
+            reader = csv.reader(fh)
+            batch: List = []
+            for line in reader:
+                if not line:
+                    continue
+                if args.field_type == "int":
+                    batch.append((int(line[0]), int(line[1])))  # col, value
+                elif len(line) >= 3 and line[2]:
+                    batch.append((int(line[0]), int(line[1]), line[2]))
+                else:
+                    batch.append((int(line[0]), int(line[1])))
+                if len(batch) >= args.batch_size:
+                    _flush_import(client, args, batch)
+                    total += len(batch)
+                    batch = []
+            if batch:
+                _flush_import(client, args, batch)
+                total += len(batch)
+        finally:
+            if fh is not sys.stdin:
+                fh.close()
+    print(f"imported {total} records", file=sys.stderr)
+    return 0
+
+
+def _flush_import(client, args, batch) -> None:
+    if args.field_type == "int":
+        client.import_values(args.host, args.index, args.field, batch)
+    else:
+        client.import_bits(args.host, args.index, args.field, batch)
+
+
+def cmd_export(args) -> int:
+    from .server.client import InternalClient
+
+    client = InternalClient()
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    try:
+        shards = client.shards_max(args.host).get(args.index, 0)
+        import urllib.request
+
+        for shard in range(shards + 1):
+            url = (f"http://{args.host}/export?index={args.index}"
+                   f"&field={args.field}&shard={shard}")
+            with urllib.request.urlopen(url) as resp:
+                out.write(resp.read().decode())
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    from .storage.bitmap import Bitmap
+
+    for path in args.paths:
+        with open(path, "rb") as f:
+            data = f.read()
+        try:
+            bm = Bitmap.from_bytes(data)
+        except ValueError as e:
+            print(f"{path}: INVALID ({e})")
+            continue
+        n_array = n_bitmap_like = 0
+        for key, c in sorted(bm.containers.items()):
+            if len(c) <= 4096:
+                n_array += 1
+            else:
+                n_bitmap_like += 1
+        print(f"{path}: containers={len(bm.containers)} bits={bm.count()} "
+              f"ops={bm.op_n} array={n_array} dense={n_bitmap_like}")
+        if args.containers:
+            for key, c in sorted(bm.containers.items()):
+                print(f"  key={key} n={len(c)}")
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Offline integrity check (reference ctl/check.go:47-123)."""
+    from .storage.bitmap import Bitmap
+
+    bad = 0
+    for path in args.paths:
+        if path.endswith(".cache") or path.endswith(".snapshotting"):
+            print(f"{path}: skipped")
+            continue
+        try:
+            with open(path, "rb") as f:
+                Bitmap.from_bytes(f.read())
+            print(f"{path}: ok")
+        except (ValueError, OSError) as e:
+            print(f"{path}: CORRUPT ({e})")
+            bad += 1
+    return 1 if bad else 0
+
+
+def cmd_config(args) -> int:
+    print(_load_config(args).to_toml(), end="")
+    return 0
+
+
+def cmd_generate_config(args) -> int:
+    print(Config().to_toml(), end="")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="pilosa-tpu",
+                                     description="TPU-native distributed bitmap index")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("server", help="run a pilosa-tpu node")
+    _add_config_flags(p)
+    p.set_defaults(fn=cmd_server)
+
+    p = sub.add_parser("import", help="bulk-import CSV data")
+    p.add_argument("--host", default="localhost:10101")
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--field", required=True)
+    p.add_argument("--create", action="store_true", help="create index/field first")
+    p.add_argument("--batch-size", type=int, default=10_000_000)
+    p.add_argument("--index-keys", action="store_true")
+    p.add_argument("--field-keys", action="store_true")
+    p.add_argument("--field-type", default="set", choices=["set", "int", "time"])
+    p.add_argument("--field-min", type=int, default=0)
+    p.add_argument("--field-max", type=int, default=0)
+    p.add_argument("--field-cache-type", default="ranked")
+    p.add_argument("--field-cache-size", type=int, default=50000)
+    p.add_argument("--field-time-quantum", default="")
+    p.add_argument("paths", nargs="+", help="CSV files ('-' for stdin)")
+    p.set_defaults(fn=cmd_import)
+
+    p = sub.add_parser("export", help="export a field as CSV")
+    p.add_argument("--host", default="localhost:10101")
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--field", required=True)
+    p.add_argument("-o", "--output", default="-")
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("inspect", help="inspect fragment files")
+    p.add_argument("--containers", action="store_true")
+    p.add_argument("paths", nargs="+")
+    p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("check", help="check fragment file integrity")
+    p.add_argument("paths", nargs="+")
+    p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("config", help="print effective configuration")
+    _add_config_flags(p)
+    p.set_defaults(fn=cmd_config)
+
+    p = sub.add_parser("generate-config", help="print default configuration")
+    p.set_defaults(fn=cmd_generate_config)
+
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except PilosaError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,210 @@
+"""Configuration: TOML file + PILOSA_TPU_* env vars + CLI flags.
+
+Port of /root/reference/server/config.go with viper's precedence model
+(cmd/root.go:56-116): flags > environment > config file > defaults.
+TOML parsing uses stdlib tomllib.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+ENV_PREFIX = "PILOSA_TPU_"
+
+
+@dataclass
+class ClusterConfig:
+    disabled: bool = True
+    coordinator: bool = True
+    replicas: int = 1
+    hosts: List[str] = field(default_factory=list)
+    long_query_time: float = 0.0
+
+
+@dataclass
+class AntiEntropyConfig:
+    interval: float = 600.0  # seconds (reference default 10m)
+
+
+@dataclass
+class MetricConfig:
+    service: str = "inmem"  # inmem | nop
+    host: str = ""
+    poll_interval: float = 0.0
+    diagnostics: bool = False
+
+
+@dataclass
+class TranslationConfig:
+    primary_url: str = ""
+
+
+@dataclass
+class Config:
+    data_dir: str = "~/.pilosa_tpu"
+    bind: str = "localhost:10101"
+    max_writes_per_request: int = 5000
+    verbose: bool = False
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
+    metric: MetricConfig = field(default_factory=MetricConfig)
+    translation: TranslationConfig = field(default_factory=TranslationConfig)
+
+    # -------------------------------------------------------------- loading
+
+    @classmethod
+    def load(cls, path: Optional[str] = None, flags: Optional[Dict[str, Any]] = None) -> "Config":
+        cfg = cls()
+        if path:
+            with open(path, "rb") as f:
+                cfg._apply_dict(tomllib.load(f))
+        cfg._apply_env()
+        if flags:
+            cfg._apply_flags(flags)
+        return cfg
+
+    def _apply_dict(self, d: dict) -> None:
+        self.data_dir = d.get("data-dir", self.data_dir)
+        self.bind = d.get("bind", self.bind)
+        self.max_writes_per_request = d.get(
+            "max-writes-per-request", self.max_writes_per_request
+        )
+        self.verbose = d.get("verbose", self.verbose)
+        c = d.get("cluster", {})
+        self.cluster.disabled = c.get("disabled", self.cluster.disabled)
+        self.cluster.coordinator = c.get("coordinator", self.cluster.coordinator)
+        self.cluster.replicas = c.get("replicas", self.cluster.replicas)
+        self.cluster.hosts = c.get("hosts", self.cluster.hosts)
+        self.cluster.long_query_time = c.get("long-query-time", self.cluster.long_query_time)
+        a = d.get("anti-entropy", {})
+        self.anti_entropy.interval = a.get("interval", self.anti_entropy.interval)
+        m = d.get("metric", {})
+        self.metric.service = m.get("service", self.metric.service)
+        self.metric.host = m.get("host", self.metric.host)
+        self.metric.poll_interval = m.get("poll-interval", self.metric.poll_interval)
+        self.metric.diagnostics = m.get("diagnostics", self.metric.diagnostics)
+        t = d.get("translation", {})
+        self.translation.primary_url = t.get("primary-url", self.translation.primary_url)
+
+    def _apply_env(self) -> None:
+        def env(name, cast=str):
+            v = os.environ.get(ENV_PREFIX + name)
+            if v is None:
+                return None
+            if cast is bool:
+                return v.lower() in ("1", "true", "yes")
+            if cast is list:
+                return [h.strip() for h in v.split(",") if h.strip()]
+            return cast(v)
+
+        for attr, name, cast in [
+            ("data_dir", "DATA_DIR", str),
+            ("bind", "BIND", str),
+            ("max_writes_per_request", "MAX_WRITES_PER_REQUEST", int),
+            ("verbose", "VERBOSE", bool),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self, attr, v)
+        for attr, name, cast in [
+            ("disabled", "CLUSTER_DISABLED", bool),
+            ("coordinator", "CLUSTER_COORDINATOR", bool),
+            ("replicas", "CLUSTER_REPLICAS", int),
+            ("hosts", "CLUSTER_HOSTS", list),
+            ("long_query_time", "CLUSTER_LONG_QUERY_TIME", float),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.cluster, attr, v)
+        v = env("ANTI_ENTROPY_INTERVAL", float)
+        if v is not None:
+            self.anti_entropy.interval = v
+        v = env("TRANSLATION_PRIMARY_URL", str)
+        if v is not None:
+            self.translation.primary_url = v
+
+    def _apply_flags(self, flags: Dict[str, Any]) -> None:
+        mapping = {
+            "data_dir": ("data_dir",),
+            "bind": ("bind",),
+            "max_writes_per_request": ("max_writes_per_request",),
+            "verbose": ("verbose",),
+            "cluster_hosts": ("cluster", "hosts"),
+            "cluster_replicas": ("cluster", "replicas"),
+            "cluster_coordinator": ("cluster", "coordinator"),
+            "cluster_disabled": ("cluster", "disabled"),
+            "long_query_time": ("cluster", "long_query_time"),
+            "anti_entropy_interval": ("anti_entropy", "interval"),
+            "translation_primary_url": ("translation", "primary_url"),
+        }
+        for key, path in mapping.items():
+            v = flags.get(key)
+            if v is None:
+                continue
+            obj = self
+            for p in path[:-1]:
+                obj = getattr(obj, p)
+            setattr(obj, path[-1], v)
+
+    # -------------------------------------------------------------- dumping
+
+    def to_toml(self) -> str:
+        def fmt(v):
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            if isinstance(v, str):
+                return f'"{v}"'
+            if isinstance(v, list):
+                return "[" + ", ".join(fmt(x) for x in v) + "]"
+            return str(v)
+
+        lines = [
+            f"data-dir = {fmt(self.data_dir)}",
+            f"bind = {fmt(self.bind)}",
+            f"max-writes-per-request = {self.max_writes_per_request}",
+            f"verbose = {fmt(self.verbose)}",
+            "",
+            "[cluster]",
+            f"disabled = {fmt(self.cluster.disabled)}",
+            f"coordinator = {fmt(self.cluster.coordinator)}",
+            f"replicas = {self.cluster.replicas}",
+            f"hosts = {fmt(self.cluster.hosts)}",
+            f"long-query-time = {self.cluster.long_query_time}",
+            "",
+            "[anti-entropy]",
+            f"interval = {self.anti_entropy.interval}",
+            "",
+            "[metric]",
+            f"service = {fmt(self.metric.service)}",
+            f"host = {fmt(self.metric.host)}",
+            f"poll-interval = {self.metric.poll_interval}",
+            f"diagnostics = {fmt(self.metric.diagnostics)}",
+            "",
+            "[translation]",
+            f"primary-url = {fmt(self.translation.primary_url)}",
+        ]
+        return "\n".join(lines) + "\n"
+
+    def build_server(self, **overrides):
+        """Construct a Server from this config."""
+        from .server.server import Server
+
+        host, _, port = self.bind.partition(":")
+        kw = dict(
+            data_dir=os.path.expanduser(self.data_dir),
+            host=host or "localhost",
+            port=int(port or 0),
+            cluster_hosts=self.cluster.hosts,
+            is_coordinator=self.cluster.coordinator,
+            replica_n=self.cluster.replicas,
+            anti_entropy_interval=self.anti_entropy.interval,
+            long_query_time=self.cluster.long_query_time,
+            metric_poll_interval=self.metric.poll_interval,
+            primary_translate_store_url=self.translation.primary_url or None,
+            max_writes_per_request=self.max_writes_per_request,
+        )
+        kw.update(overrides)
+        return Server(**kw)

@@ -1,0 +1,129 @@
+"""CLI + config tests (model: reference cmd/*_test.go, ctl import/export
+tests against an in-process node)."""
+
+import json
+import os
+
+import pytest
+
+from pilosa_tpu.cli import main
+from pilosa_tpu.config import Config
+from pilosa_tpu.server.server import Server
+
+
+def test_config_precedence(tmp_path, monkeypatch):
+    cfg_file = tmp_path / "cfg.toml"
+    cfg_file.write_text(
+        'data-dir = "/from/file"\nbind = "localhost:1111"\n'
+        "[cluster]\nreplicas = 2\n"
+    )
+    cfg = Config.load(str(cfg_file))
+    assert cfg.data_dir == "/from/file"
+    assert cfg.cluster.replicas == 2
+    # Env beats file.
+    monkeypatch.setenv("PILOSA_TPU_DATA_DIR", "/from/env")
+    cfg = Config.load(str(cfg_file))
+    assert cfg.data_dir == "/from/env"
+    # Flags beat env.
+    cfg = Config.load(str(cfg_file), {"data_dir": "/from/flag"})
+    assert cfg.data_dir == "/from/flag"
+
+
+def test_config_toml_roundtrip(tmp_path):
+    toml = Config().to_toml()
+    p = tmp_path / "default.toml"
+    p.write_text(toml)
+    cfg = Config.load(str(p))
+    assert cfg.bind == Config().bind
+    assert cfg.cluster.replicas == Config().cluster.replicas
+
+
+def test_generate_config(capsys):
+    assert main(["generate-config"]) == 0
+    out = capsys.readouterr().out
+    assert "data-dir" in out and "[cluster]" in out
+
+
+def test_config_command_with_flags(capsys):
+    assert main(["config", "--bind", "0.0.0.0:9999"]) == 0
+    assert 'bind = "0.0.0.0:9999"' in capsys.readouterr().out
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = Server(data_dir=str(tmp_path / "srv"), cache_flush_interval=0)
+    s.open()
+    yield s
+    s.close()
+
+
+def test_import_export_roundtrip(tmp_path, server, capsys):
+    csv_path = tmp_path / "bits.csv"
+    csv_path.write_text("1,10\n1,20\n2,30\n")
+    rc = main([
+        "import", "--host", f"localhost:{server.port}",
+        "-i", "imp", "-f", "f", "--create", str(csv_path),
+    ])
+    assert rc == 0
+    out_path = tmp_path / "out.csv"
+    rc = main([
+        "export", "--host", f"localhost:{server.port}",
+        "-i", "imp", "-f", "f", "-o", str(out_path),
+    ])
+    assert rc == 0
+    assert sorted(out_path.read_text().strip().splitlines()) == ["1,10", "1,20", "2,30"]
+
+
+def test_import_int_field(tmp_path, server):
+    csv_path = tmp_path / "vals.csv"
+    csv_path.write_text("1,100\n2,250\n")
+    rc = main([
+        "import", "--host", f"localhost:{server.port}",
+        "-i", "impv", "-f", "v", "--create",
+        "--field-type", "int", "--field-min", "0", "--field-max", "1000",
+        str(csv_path),
+    ])
+    assert rc == 0
+    from pilosa_tpu.server.client import InternalClient
+
+    resp = InternalClient().query(f"localhost:{server.port}", "impv", "Sum(field=v)")
+    assert resp["results"][0] == {"value": 350, "count": 2}
+
+
+def test_import_with_timestamps(tmp_path, server):
+    csv_path = tmp_path / "ts.csv"
+    csv_path.write_text("1,10,2018-01-02T00:00\n")
+    rc = main([
+        "import", "--host", f"localhost:{server.port}",
+        "-i", "impt", "-f", "t", "--create",
+        "--field-time-quantum", "YMD", str(csv_path),
+    ])
+    assert rc == 0
+    from pilosa_tpu.server.client import InternalClient
+
+    resp = InternalClient().query(
+        f"localhost:{server.port}", "impt",
+        "Range(t=1, 2018-01-01T00:00, 2018-01-03T00:00)",
+    )
+    assert resp["results"][0]["columns"] == [10]
+
+
+def test_inspect_and_check(tmp_path, server, capsys):
+    from pilosa_tpu.server.client import InternalClient
+
+    client = InternalClient()
+    client.create_index(f"localhost:{server.port}", "chk")
+    client.create_field(f"localhost:{server.port}", "chk", "f")
+    client.query(f"localhost:{server.port}", "chk", "Set(1, f=1)")
+    frag_path = os.path.join(
+        server.data_dir, "indexes", "chk", "f", "views", "standard", "fragments", "0"
+    )
+    assert os.path.exists(frag_path)
+    assert main(["inspect", frag_path]) == 0
+    out = capsys.readouterr().out
+    assert "bits=1" in out
+    assert main(["check", frag_path]) == 0
+    # Corrupt file detected.
+    bad = tmp_path / "bad"
+    bad.write_bytes(b"\x00" * 32)
+    assert main(["check", str(bad)]) == 1
