@@ -31,8 +31,19 @@ from ..constants import BITS_PER_WORD, SHARD_WIDTH, WORDS_PER_ROW
 
 
 def pack_bits(cols: np.ndarray, width: int = SHARD_WIDTH) -> np.ndarray:
-    """Pack sorted column ids (< width) into a uint32 bitplane (numpy, host)."""
-    words = np.zeros(width // BITS_PER_WORD, dtype=np.uint32)
+    """Pack sorted column ids (< width) into a uint32 bitplane (host).
+
+    Uses the native C++ kernel when built (np.bitwise_or.at is an order of
+    magnitude slower); numpy fallback otherwise.
+    """
+    n_words = width // BITS_PER_WORD
+    if len(cols):
+        from .. import native
+
+        packed = native.pack_bits(np.asarray(cols, dtype=np.uint32), n_words)
+        if packed is not None:
+            return packed
+    words = np.zeros(n_words, dtype=np.uint32)
     if len(cols):
         cols = np.asarray(cols, dtype=np.uint32)
         np.bitwise_or.at(words, cols >> 5, np.uint32(1) << (cols & np.uint32(31)))
@@ -42,6 +53,12 @@ def pack_bits(cols: np.ndarray, width: int = SHARD_WIDTH) -> np.ndarray:
 def unpack_bits(plane: np.ndarray) -> np.ndarray:
     """Bitplane -> ascending uint64 column ids (numpy, host)."""
     plane = np.ascontiguousarray(np.asarray(plane, dtype=np.uint32))
+    from .. import native
+
+    if native.available():
+        out = native.unpack_bits(plane)
+        if out is not None:
+            return out
     bits = np.unpackbits(plane.view(np.uint8), bitorder="little")
     return np.flatnonzero(bits).astype(np.uint64)
 

@@ -205,33 +205,47 @@ class Bitmap:
 
     # ------------------------------------------------------ set algebra (oracle)
 
-    def _binop(self, other: "Bitmap", fn) -> "Bitmap":
+    def _binop(self, other: "Bitmap", fn, native_name=None) -> "Bitmap":
+        from .. import native
+
+        nat = getattr(native, native_name) if native_name and native.available() else None
         out = Bitmap()
         for key in set(self.containers) | set(other.containers):
             a = self.containers.get(key, _empty())
             b = other.containers.get(key, _empty())
-            c = fn(a, b)
+            c = nat(a, b) if nat is not None else fn(a, b)
             if len(c):
                 out.containers[key] = c.astype(np.uint16)
         return out
 
     def union(self, other: "Bitmap") -> "Bitmap":
-        return self._binop(other, np.union1d)
+        return self._binop(other, np.union1d, "union_u16")
 
     def intersect(self, other: "Bitmap") -> "Bitmap":
-        return self._binop(other, lambda a, b: np.intersect1d(a, b, assume_unique=True))
+        return self._binop(
+            other, lambda a, b: np.intersect1d(a, b, assume_unique=True), "intersect_u16"
+        )
 
     def difference(self, other: "Bitmap") -> "Bitmap":
-        return self._binop(other, lambda a, b: np.setdiff1d(a, b, assume_unique=True))
+        return self._binop(
+            other, lambda a, b: np.setdiff1d(a, b, assume_unique=True), "difference_u16"
+        )
 
     def xor(self, other: "Bitmap") -> "Bitmap":
-        return self._binop(other, np.setxor1d)
+        return self._binop(other, np.setxor1d, "xor_u16")
 
     def intersection_count(self, other: "Bitmap") -> int:
+        from .. import native
+
+        use_native = native.available()
         n = 0
         for key, a in self.containers.items():
             b = other.containers.get(key)
-            if b is not None:
+            if b is None:
+                continue
+            if use_native:
+                n += native.intersection_count_u16(a, b)
+            else:
                 n += len(np.intersect1d(a, b, assume_unique=True))
         return n
 
