@@ -462,12 +462,14 @@ class Fragment:
         all_sets = [set(zip(local_rows.tolist(), local_cols.tolist()))]
         for rows, cols in data:
             all_sets.append(set(zip(np.asarray(rows).tolist(), np.asarray(cols).tolist())))
-        n_voters = len(all_sets)
+        # Even splits keep the bit (reference fragment.go:1218 majorityN =
+        # (n+1)/2 with setN >= majorityN).
+        majority = (len(all_sets) + 1) // 2
         votes: Dict[Tuple[int, int], int] = {}
         for s in all_sets:
             for pair in s:
                 votes[pair] = votes.get(pair, 0) + 1
-        consensus = {p for p, v in votes.items() if v * 2 > n_voters}
+        consensus = {p for p, v in votes.items() if v >= majority}
 
         sets_out, clears_out = [], []
         for i, s in enumerate(all_sets):
