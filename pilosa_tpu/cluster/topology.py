@@ -1,0 +1,72 @@
+"""Topology persistence + post-resize holder cleanup.
+
+Port of the reference's `.topology` checkpoint (cluster.go:1442-1580) and
+holderCleaner (holder.go:777-835): the node set survives restarts, and
+after a resize each node garbage-collects fragments for shards it no
+longer owns.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import List, Optional
+
+from .node import Node
+
+
+class Topology:
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.node_ids: List[str] = []
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "Topology":
+        t = cls(path)
+        if path and os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+            t.node_ids = data.get("nodeIDs", [])
+        return t
+
+    def save(self, nodes: List[Node]) -> None:
+        self.node_ids = [n.id for n in nodes]
+        if not self.path:
+            return
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"nodeIDs": self.node_ids}, f)
+        os.replace(tmp, self.path)
+
+    def contains_id(self, node_id: str) -> bool:
+        return node_id in self.node_ids
+
+
+class HolderCleaner:
+    """Removes fragments this node no longer owns (holder.go:777-835)."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def clean_holder(self) -> List[str]:
+        cluster = self.server.cluster
+        holder = self.server.holder
+        removed: List[str] = []
+        for index_name in holder.index_names():
+            idx = holder.index(index_name)
+            for field in idx.fields.values():
+                for view in field.views.values():
+                    for shard in list(view.fragments):
+                        if cluster.owns_shard(cluster.node.id, index_name, shard):
+                            continue
+                        frag = view.fragments.pop(shard)
+                        frag.close()
+                        if frag.path and os.path.exists(frag.path):
+                            os.remove(frag.path)
+                        cache = frag.cache_path()
+                        if cache and os.path.exists(cache):
+                            os.remove(cache)
+                        removed.append(f"{index_name}/{field.name}/{view.name}/{shard}")
+        return removed

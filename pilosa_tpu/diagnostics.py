@@ -1,0 +1,75 @@
+"""Anonymized diagnostics collector (port of /root/reference/diagnostics.go).
+
+Gathers non-sensitive deployment stats (version, uptime, schema shape,
+cluster size, host info) and periodically POSTs them to a configurable
+endpoint. Disabled by default (interval 0 / empty endpoint) — the
+reference's hourly phone-home to diagnostics.pilosa.com becomes opt-in.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Dict, Optional
+
+from . import __version__
+from .sysinfo import system_info
+
+
+class DiagnosticsCollector:
+    def __init__(self, server, endpoint: str = "", interval: float = 0.0, logger=None):
+        self.server = server
+        self.endpoint = endpoint
+        self.interval = interval
+        self.logger = logger
+        self.start_time = time.time()
+        self._extra: Dict[str, object] = {}
+        self.last_report: Optional[dict] = None
+
+    def set(self, key: str, value) -> None:
+        self._extra[key] = value
+
+    def gather(self) -> dict:
+        holder = self.server.holder
+        num_fields = sum(len(i.fields) for i in holder.indexes.values())
+        num_frags = sum(
+            len(v.fragments)
+            for i in holder.indexes.values()
+            for f in i.fields.values()
+            for v in f.views.values()
+        )
+        info = {
+            "version": __version__,
+            "uptime": int(time.time() - self.start_time),
+            "numIndexes": len(holder.indexes),
+            "numFields": num_fields,
+            "numFragments": num_frags,
+            "clusterNodes": len(self.server.cluster.nodes),
+            "clusterState": self.server.cluster.state,
+            "nodeID": self.server.cluster.node.id,
+        }
+        info.update(system_info())
+        info.update(self._extra)
+        return info
+
+    def flush(self) -> bool:
+        """POST one report; returns success. No-op without an endpoint."""
+        report = self.gather()
+        self.last_report = report
+        if not self.endpoint:
+            return False
+        try:
+            req = urllib.request.Request(
+                self.endpoint,
+                data=json.dumps(report).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10):
+                return True
+        except OSError as e:
+            if self.logger:
+                self.logger.debug("diagnostics flush failed: %s", e)
+            return False

@@ -51,6 +51,8 @@ class Server:
         primary_translate_store_url: Optional[str] = None,
         max_writes_per_request: int = 5000,
         executor_workers: int = 8,
+        diagnostics_interval: float = 0.0,
+        diagnostics_endpoint: str = "",
     ):
         self.data_dir = data_dir
         self.host = host
@@ -91,6 +93,17 @@ class Server:
         self.api = API(self)
         self.handler = Handler(self.api, logger=self.logger)
 
+        from ..cluster.topology import Topology
+        from ..diagnostics import DiagnosticsCollector
+
+        self.topology = Topology.load(
+            os.path.join(data_dir, ".topology") if data_dir else None
+        )
+        self.diagnostics = DiagnosticsCollector(
+            self, endpoint=diagnostics_endpoint, interval=diagnostics_interval,
+            logger=self.logger,
+        )
+        self.resize_coordinator = None  # set on demand by coordinators
         self._httpd = None
         self._http_thread = None
         self._stop = threading.Event()
@@ -146,6 +159,9 @@ class Server:
             self._spawn(self._monitor_runtime, self.metric_poll_interval)
         if self.primary_translate_store_url:
             self._spawn(self._monitor_translate_replication, 1.0)
+        if self.diagnostics.interval > 0:
+            self._spawn(self.diagnostics.flush, self.diagnostics.interval)
+        self.topology.save(self.cluster.nodes)
         self.opened = True
         return self
 
@@ -261,8 +277,18 @@ class Server:
         elif typ == "schema":
             self.holder.apply_schema(msg["schema"])
         elif typ == "cluster-status":
+            prev_state = self.cluster.state
             self.cluster.state = msg.get("state", self.cluster.state)
             self.cluster.nodes = [Node.from_dict(n) for n in msg.get("nodes", [])]
+            self.topology.save(self.cluster.nodes)
+            if prev_state == STATE_RESIZING and self.cluster.state == STATE_NORMAL:
+                # Post-resize GC of shards this node no longer owns
+                # (reference holderCleaner, holder.go:777-835).
+                from ..cluster.topology import HolderCleaner
+
+                removed = HolderCleaner(self).clean_holder()
+                if removed:
+                    self.logger.info("holder cleaner removed %d fragments", len(removed))
         elif typ == "set-coordinator":
             for n in self.cluster.nodes:
                 n.is_coordinator = n.id == msg["nodeID"]

@@ -1,0 +1,195 @@
+"""Tests for URI, iterators, diagnostics, sysinfo, topology, holder cleaner,
+stats, time quantum, and translate replication."""
+
+import json
+import time
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import timeq
+from pilosa_tpu.cluster.node import Cluster, Node
+from pilosa_tpu.cluster.topology import HolderCleaner, Topology
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.diagnostics import DiagnosticsCollector
+from pilosa_tpu.iterator import BufIterator, fragment_iterator, limit_iterator, slice_iterator
+from pilosa_tpu.stats import InMemoryStatsClient, MultiStatsClient, NopStatsClient, Timer
+from pilosa_tpu.sysinfo import system_info
+from pilosa_tpu.translate import TranslateStore
+from pilosa_tpu.uri import URI, URIError
+
+
+def test_uri_parse():
+    u = URI.parse("https://example.com:8080")
+    assert (u.scheme, u.host, u.port) == ("https", "example.com", 8080)
+    assert URI.parse("example.com").port == 10101
+    assert URI.parse(":9999").host == "localhost"
+    assert URI.parse("localhost:1").normalize() == "http://localhost:1"
+    with pytest.raises(URIError):
+        URI.parse("")
+
+
+def test_fragment_iterator(tmp_path):
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 2)
+    f.open()
+    from pilosa_tpu.constants import SHARD_WIDTH
+
+    base = 2 * SHARD_WIDTH
+    f.set_bit(0, base + 5)
+    f.set_bit(3, base + 1)
+    pairs = list(fragment_iterator(f))
+    assert pairs == [(0, base + 5), (3, base + 1)]
+    assert list(fragment_iterator(f, seek_row=1)) == [(3, base + 1)]
+    f.close()
+
+
+def test_buf_slice_limit_iterators():
+    it = BufIterator(slice_iterator([2, 1, 1], [5, 9, 3]))
+    assert it.peek() == (1, 3)
+    assert it.next() == (1, 3)
+    it.unread((1, 3))
+    assert it.next() == (1, 3)
+    assert list(limit_iterator(slice_iterator([0, 1, 2], [1, 2, 3]), 2, 100)) == [
+        (0, 1), (1, 2),
+    ]
+
+
+def test_time_quantum_views():
+    t = datetime(2018, 3, 5, 14)
+    assert timeq.views_by_time("standard", t, "YMDH") == [
+        "standard_2018", "standard_201803", "standard_20180305",
+        "standard_2018030514",
+    ]
+    views = timeq.views_by_time_range(
+        "standard", datetime(2018, 1, 31, 22), datetime(2018, 2, 2, 0), "YMDH"
+    )
+    # 2 hours + 1 day cover the range minimally.
+    assert views == [
+        "standard_2018013122", "standard_2018013123", "standard_20180201",
+    ]
+
+
+def test_stats_clients():
+    s = InMemoryStatsClient()
+    s.count("x", 2)
+    s.count("x", 3)
+    s.gauge("g", 7)
+    tagged = s.with_tags("index:i")
+    tagged.count("x", 1)
+    snap = s.snapshot()
+    assert snap["counters"]["x"] == 5
+    assert snap["counters"]["x|index:i"] == 1
+    assert snap["gauges"]["g"] == 7
+    multi = MultiStatsClient([NopStatsClient(), s])
+    multi.count("y", 1)
+    assert s.snapshot()["counters"]["y"] == 1
+    with Timer(s, "op"):
+        pass
+    assert "op" in s.snapshot()["timings"]
+
+
+def test_sysinfo():
+    info = system_info()
+    assert info["OS"] == "Linux"
+    assert info["numCPU"] > 0
+    assert info["memTotal"] > 0
+
+
+def test_topology_persistence(tmp_path):
+    path = str(tmp_path / ".topology")
+    t = Topology.load(path)
+    assert t.node_ids == []
+    t.save([Node(id="a"), Node(id="b")])
+    t2 = Topology.load(path)
+    assert t2.node_ids == ["a", "b"]
+    assert t2.contains_id("a") and not t2.contains_id("c")
+
+
+class _FakeServer:
+    def __init__(self, holder, cluster):
+        self.holder = holder
+        self.cluster = cluster
+
+
+def test_holder_cleaner(tmp_path):
+    from pilosa_tpu.cluster.hash import ModHasher
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.core.holder import Holder
+
+    holder = Holder(str(tmp_path / "data"))
+    holder.open()
+    idx = holder.create_index("i")
+    fld = idx.create_field("f")
+    for s in range(4):
+        fld.set_bit(1, s * SHARD_WIDTH + 1)
+    nodes = [Node(id="me"), Node(id="other")]
+    cluster = Cluster(node=nodes[0], nodes=nodes, hasher=ModHasher())
+    removed = HolderCleaner(_FakeServer(holder, cluster)).clean_holder()
+    view = fld.view("standard")
+    kept = set(view.fragments)
+    assert all(cluster.owns_shard("me", "i", s) for s in kept)
+    assert len(removed) == 4 - len(kept)
+    holder.close()
+
+
+def test_diagnostics_gather_and_flush(tmp_path):
+    import http.server
+    import threading
+
+    received = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("localhost", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    from pilosa_tpu.core.holder import Holder
+
+    holder = Holder(None)
+    holder.open()
+    holder.create_index("i").create_field("f")
+    cluster = Cluster()
+    server = _FakeServer(holder, cluster)
+    d = DiagnosticsCollector(
+        server, endpoint=f"http://localhost:{httpd.server_address[1]}/diag"
+    )
+    assert d.flush()
+    assert received[0]["numIndexes"] == 1
+    assert received[0]["numFields"] == 1
+    assert received[0]["OS"] == "Linux"
+    httpd.shutdown()
+    # No endpoint -> gather only.
+    d2 = DiagnosticsCollector(server)
+    assert not d2.flush()
+    assert d2.last_report["numIndexes"] == 1
+
+
+def test_translate_replication(tmp_path):
+    primary = TranslateStore(str(tmp_path / "primary")).open()
+    primary.translate_columns_to_uint64("i", ["a", "b"])
+    primary.translate_rows_to_uint64("i", "f", ["x"])
+    replica = TranslateStore(str(tmp_path / "replica"), read_only=True).open()
+    data = primary.read_from(0)
+    replica.apply_log(data)
+    assert replica.translate_columns_to_uint64("i", ["a", "b"]) == [1, 2]
+    assert replica.translate_row_to_string("i", "f", 1) == "x"
+    # Replica refuses new keys.
+    from pilosa_tpu.errors import TranslateStoreReadOnlyError
+
+    with pytest.raises(TranslateStoreReadOnlyError):
+        replica.translate_columns_to_uint64("i", ["new"])
+    # Incremental tail.
+    size = replica.size()
+    primary.translate_columns_to_uint64("i", ["c"])
+    replica.apply_log(primary.read_from(size))
+    assert replica.translate_columns_to_uint64("i", ["c"]) == [3]
