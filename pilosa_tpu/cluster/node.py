@@ -52,6 +52,10 @@ class Cluster:
         self.partition_n = partition_n
         self.hasher = hasher or JmpHasher()
         self.state = STATE_NORMAL
+        # Node ids currently failing health probes (failure detector; the
+        # reference's memberlist suspicion state). Placement ignores this;
+        # the executor's owner selection and retry logic consult it.
+        self.unavailable: set = set()
 
     # ------------------------------------------------------------ placement
 
@@ -69,6 +73,20 @@ class Cluster:
 
     def shard_nodes(self, index: str, shard: int) -> List[Node]:
         return self.partition_nodes(self.partition(index, shard))
+
+    def available_shard_nodes(self, index: str, shard: int, exclude=()) -> List[Node]:
+        """Owners that are believed alive and not in `exclude`."""
+        return [
+            n
+            for n in self.shard_nodes(index, shard)
+            if n.id not in self.unavailable and n.id not in exclude
+        ]
+
+    def mark_unavailable(self, node_id: str) -> None:
+        self.unavailable.add(node_id)
+
+    def mark_available(self, node_id: str) -> None:
+        self.unavailable.discard(node_id)
 
     def owns_shard(self, node_id: str, index: str, shard: int) -> bool:
         return any(n.id == node_id for n in self.shard_nodes(index, shard))

@@ -97,18 +97,6 @@ class Executor:
             self._engine = ShardedQueryEngine(self.holder)
         return self._engine
 
-    def _partition_shards(self, index: str, shards: List[int]):
-        """Split shards into locally-owned vs per-remote-node groups."""
-        local: List[int] = []
-        remote: Dict[str, List[int]] = {}
-        for shard in shards:
-            nodes = self.cluster.shard_nodes(index, shard)
-            owner = next((n for n in nodes if n.id == self.node.id), nodes[0])
-            if owner.id == self.node.id:
-                local.append(shard)
-            else:
-                remote.setdefault(owner.id, []).append(shard)
-        return local, remote
 
     @property
     def node(self):
@@ -181,34 +169,70 @@ class Executor:
 
     # ----------------------------------------------------------- mapReduce
 
+    def _assign_shards(self, index: str, shards: List[int], exclude=()):
+        """Shards -> (local list, {node_id: shards}) using availability info.
+
+        Prefers self when a replica (maximizes local device work,
+        executor.go:1444-1458); skips nodes in `exclude`/marked unavailable.
+        """
+        local: List[int] = []
+        remote: Dict[str, List[int]] = {}
+        for shard in shards:
+            nodes = self.cluster.available_shard_nodes(index, shard, exclude)
+            if not nodes:
+                raise PilosaError(f"no available node owns shard {shard}")
+            owner = next((n for n in nodes if n.id == self.node.id), nodes[0])
+            if owner.id == self.node.id:
+                local.append(shard)
+            else:
+                remote.setdefault(owner.id, []).append(shard)
+        return local, remote
+
     def _map_reduce(self, index: str, shards: List[int], c: Call, opt: ExecOptions, map_fn, reduce_fn):
         """Group shards by owning node; local shards run concurrently on the
-        device, remote nodes get one batched query (executor.go:1464-1593)."""
-        result = None
-        by_node: Dict[str, List[int]] = {}
-        for shard in shards:
-            nodes = self.cluster.shard_nodes(index, shard)
-            # Prefer self if a replica; else primary (reference picks the
-            # option that maximizes local work, executor.go:1444-1458).
-            owner = next((n for n in nodes if n.id == self.node.id), nodes[0])
-            by_node.setdefault(owner.id, []).append(shard)
+        device, remote nodes get one batched query. Failed nodes are marked
+        and their shards re-mapped onto replicas (executor.go:1464-1555)."""
 
-        for node_id, node_shards in by_node.items():
-            if node_id == self.node.id:
-                if self._pool is not None and len(node_shards) > 1:
-                    values = list(self._pool.map(map_fn, node_shards))
-                else:
-                    values = [map_fn(s) for s in node_shards]
-                for v in values:
-                    result = v if result is None else reduce_fn(result, v)
+        def local_runner(local_shards):
+            if self._pool is not None and len(local_shards) > 1:
+                values = list(self._pool.map(map_fn, local_shards))
             else:
+                values = [map_fn(s) for s in local_shards]
+            result = None
+            for v in values:
+                result = v if result is None else reduce_fn(result, v)
+            return result
+
+        return self._fan_out(index, shards, c, opt, local_runner, reduce_fn)
+
+    def _fan_out(self, index, shards, c, opt, local_runner, reduce_fn):
+        from .server.client import ClientError
+
+        result = None
+        failed: set = set()
+        pending = list(shards)
+        while pending:
+            local, remote = self._assign_shards(index, pending, exclude=failed)
+            pending = []
+            if local:
+                v = local_runner(local)
+                if v is not None:
+                    result = v if result is None else reduce_fn(result, v)
+            for node_id, node_shards in remote.items():
                 if opt.remote:
                     continue  # remote calls are restricted to local shards
                 node = self.cluster.node_by_id(node_id)
-                remote_results = self.client.query_node(
-                    node, index, str(c), shards=node_shards, remote=True
-                )
-                v = remote_results[0]
+                try:
+                    v = self.client.query_node(
+                        node, index, str(c), shards=node_shards, remote=True
+                    )[0]
+                except ClientError:
+                    # Mark failed, re-map its shards onto replicas
+                    # (executor.go:1498-1508 mapper retry).
+                    failed.add(node_id)
+                    self.cluster.mark_unavailable(node_id)
+                    pending.extend(node_shards)
+                    continue
                 result = v if result is None else reduce_fn(result, v)
         return result
 
@@ -379,22 +403,12 @@ class Executor:
         the reference-style per-shard map/reduce."""
         target = child if child is not None else c
         if shards and self.engine.supports(target):
-            local, remote = self._partition_shards(index, shards)
-            result = None
-            if local:
+            def local_runner(local_shards):
                 if kind == "count":
-                    result = self.engine.count(index, target, local)
-                else:
-                    result = self.engine.bitmap(index, target, local)
-            for node_id, node_shards in remote.items():
-                if opt.remote:
-                    continue
-                node = self.cluster.node_by_id(node_id)
-                v = self.client.query_node(
-                    node, index, str(c), shards=node_shards, remote=True
-                )[0]
-                result = v if result is None else reduce_fn(result, v)
-            return result
+                    return self.engine.count(index, target, local_shards)
+                return self.engine.bitmap(index, target, local_shards)
+
+            return self._fan_out(index, shards, c, opt, local_runner, reduce_fn)
         return self._map_reduce(index, shards, c, opt, map_fn, reduce_fn)
 
     # --------------------------------------------------------- sum/min/max

@@ -53,6 +53,7 @@ class Server:
         executor_workers: int = 8,
         diagnostics_interval: float = 0.0,
         diagnostics_endpoint: str = "",
+        member_monitor_interval: float = 2.0,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -62,6 +63,7 @@ class Server:
         self.long_query_time = long_query_time
         self.anti_entropy_interval = anti_entropy_interval
         self.cache_flush_interval = cache_flush_interval
+        self.member_monitor_interval = member_monitor_interval
         self.metric_poll_interval = metric_poll_interval
         self.primary_translate_store_url = primary_translate_store_url
 
@@ -82,6 +84,7 @@ class Server:
             read_only=primary_translate_store_url is not None,
         )
         self.client = InternalClient()
+        self._probe_client = InternalClient(timeout=2.0)
         self.executor = Executor(
             self.holder,
             cluster=self.cluster,
@@ -161,6 +164,8 @@ class Server:
             self._spawn(self._monitor_translate_replication, 1.0)
         if self.diagnostics.interval > 0:
             self._spawn(self.diagnostics.flush, self.diagnostics.interval)
+        if self.member_monitor_interval > 0 and len(self.cluster.nodes) > 1:
+            self._spawn(self._monitor_members, self.member_monitor_interval)
         self.topology.save(self.cluster.nodes)
         self.opened = True
         return self
@@ -212,6 +217,25 @@ class Server:
             self.stats.gauge("openFiles", len(os.listdir("/proc/self/fd")))
         except OSError:
             pass
+
+    def _monitor_members(self) -> None:
+        """Heartbeat failure detector (the reference's memberlist gossip
+        probes, gossip/gossip.go). Probes peer /status; marks nodes
+        unavailable so the executor routes around them, and re-marks them
+        available on recovery."""
+        for node in list(self.cluster.nodes):
+            if node.id == self.node.id:
+                continue
+            try:
+                self._probe_client.status(node.uri)
+            except PilosaError:
+                if node.id not in self.cluster.unavailable:
+                    self.logger.info("node %s marked unavailable", node.id)
+                self.cluster.mark_unavailable(node.id)
+            else:
+                if node.id in self.cluster.unavailable:
+                    self.logger.info("node %s recovered", node.id)
+                self.cluster.mark_available(node.id)
 
     def _monitor_translate_replication(self) -> None:
         data = self.client.translate_data(

@@ -1,0 +1,132 @@
+"""Failure detection + query-time replica retry.
+
+Model: reference executor.go:1498-1508 (mapReduce retry on replicas) and
+memberlist gossip failure surfacing. A 3-node replica_n=2 cluster keeps
+answering full queries after one node dies.
+"""
+
+import socket
+import time
+
+import pytest
+
+from pilosa_tpu.cluster.hash import ModHasher
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.server.client import ClientError, InternalClient
+from pilosa_tpu.server.server import Server
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def cluster3r(tmp_path):
+    ports = [free_port() for _ in range(3)]
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = []
+    for i, port in enumerate(ports):
+        s = Server(
+            data_dir=str(tmp_path / f"node{i}"),
+            port=port,
+            cluster_hosts=hosts,
+            replica_n=2,
+            hasher=ModHasher(),
+            cache_flush_interval=0,
+            anti_entropy_interval=0,
+            member_monitor_interval=0,  # tests trigger probes manually
+            executor_workers=0,
+        )
+        s.open()
+        servers.append(s)
+    yield servers
+    for s in servers:
+        try:
+            s.close()
+        except Exception:
+            pass
+
+
+def test_query_survives_node_death(cluster3r):
+    client = InternalClient()
+    h0 = f"localhost:{cluster3r[0].port}"
+    client.create_index(h0, "fi")
+    client.create_field(h0, "fi", "f")
+    time.sleep(0.05)
+    cols = [1, SHARD_WIDTH + 2, 2 * SHARD_WIDTH + 3]
+    for col in cols:
+        client.query(h0, "fi", f"Set({col}, f=1)")
+    assert client.query(h0, "fi", "Count(Row(f=1))")["results"][0] == 3
+
+    # Kill the node that node0 will pick as remote owner for some shard:
+    # the first owner of a shard node0 does not replicate.
+    s0 = cluster3r[0]
+    target_id = None
+    for shard in range(3):
+        owners = s0.cluster.shard_nodes("fi", shard)
+        if all(n.id != s0.node.id for n in owners):
+            target_id = owners[0].id
+            break
+    assert target_id is not None, "placement gave node0 every shard"
+    dead = next(s for s in cluster3r if s.node.id == target_id)
+    dead.close()
+
+    # Query from node0: remote call to the dead node fails, the executor
+    # marks it unavailable and retries its shards on replicas.
+    resp = client.query(h0, "fi", "Count(Row(f=1))")
+    assert resp["results"][0] == 3
+    assert dead.node.id in s0.cluster.unavailable
+    resp = client.query(h0, "fi", "Row(f=1)")
+    assert resp["results"][0]["columns"] == cols
+
+
+def test_member_monitor_detects_death_and_recovery(cluster3r):
+    s0, s1, _ = cluster3r
+    s0._monitor_members()
+    assert s0.cluster.unavailable == set()
+    port = s1.port
+    s1.close()
+    s0._monitor_members()
+    assert s1.node.id in s0.cluster.unavailable
+    # Restart on the same port -> recovery detected.
+    s1b = Server(
+        data_dir=s1.data_dir,
+        port=port,
+        cluster_hosts=[n.uri for n in s0.cluster.nodes],
+        replica_n=2,
+        hasher=ModHasher(),
+        cache_flush_interval=0,
+        member_monitor_interval=0,
+        executor_workers=0,
+    )
+    s1b.open()
+    try:
+        s0._monitor_members()
+        assert s1b.node.id not in s0.cluster.unavailable
+    finally:
+        s1b.close()
+
+
+def test_no_available_replica_errors(cluster3r):
+    client = InternalClient()
+    h0 = f"localhost:{cluster3r[0].port}"
+    client.create_index(h0, "fx")
+    client.create_field(h0, "fx", "f")
+    time.sleep(0.05)
+    client.query(h0, "fx", f"Set({SHARD_WIDTH + 1}, f=1)")
+    # Kill both non-local nodes; shards owned only by them are unreachable.
+    cluster3r[1].close()
+    cluster3r[2].close()
+    # Some shard will have no available owner -> error, not silent data loss.
+    s0 = cluster3r[0]
+    unreachable = [
+        sh for sh in range(2)
+        if all(n.id != s0.node.id for n in s0.cluster.shard_nodes("fx", sh))
+    ]
+    if unreachable:
+        with pytest.raises(ClientError):
+            client.query(h0, "fx", "Count(Row(f=1))")
