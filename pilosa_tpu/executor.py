@@ -429,7 +429,39 @@ class Executor:
                 return prev.smaller(v)
             return prev.larger(v)
 
-        result = self._map_reduce(index, shards, c, opt, map_fn, reduce_fn) or ValCount()
+        field_name = c.args.get("field")
+        fld = self.holder.field(index, field_name)
+        bsig = fld.bsi_group(field_name) if fld else None
+        filter_call = c.children[0] if c.children else None
+        local_runner = None
+        if bsig is not None and (
+            filter_call is None or self.engine.supports(filter_call)
+        ):
+            # Batched path: one device program per node covering all its
+            # shards (replaces the per-shard ValCount merge loop).
+            depth = bsig.bit_depth()
+
+            def local_runner(local_shards):
+                if kind == "sum":
+                    counts = self.engine.bsi_val_count(
+                        index, field_name, "sum", depth, local_shards, filter_call
+                    )
+                    vcount = int(counts[depth])
+                    vsum = sum((1 << i) * int(counts[i]) for i in range(depth))
+                    return ValCount(vsum + vcount * bsig.min, vcount)
+                bits, count = self.engine.bsi_val_count(
+                    index, field_name, kind, depth, local_shards, filter_call
+                )
+                if count == 0:
+                    return ValCount()
+                from .ops.bitplane import compose_bits
+
+                return ValCount(compose_bits(bits) + bsig.min, count)
+
+        if local_runner is not None:
+            result = self._fan_out(index, shards, c, opt, local_runner, reduce_fn) or ValCount()
+        else:
+            result = self._map_reduce(index, shards, c, opt, map_fn, reduce_fn) or ValCount()
         if result.count == 0:
             return ValCount()
         return result
@@ -480,7 +512,42 @@ class Executor:
         def map_fn(shard):
             return self._execute_topn_shard(index, c, shard)
 
-        result = self._map_reduce(index, shards, c, opt, map_fn, add_pairs) or []
+        local_runner = None
+        ids = self._uint_slice_arg(c, "ids")
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        src_call = c.children[0] if c.children else None
+        if (
+            ids
+            and not c.args.get("attrName")
+            and not tanimoto
+            and (src_call is None or self.engine.supports(src_call))
+        ):
+            # Batched phase-2: all candidate counts across all local shards
+            # in one device program, preserving per-shard MinThreshold
+            # semantics (fragment.go:899-990).
+            field_name = c.args.get("_field") or DEFAULT_FIELD
+            thr = max(c.uint_arg("threshold")[0], DEFAULT_MIN_THRESHOLD)
+
+            def local_runner(local_shards):
+                row_counts, inter = self.engine.topn_shard_counts(
+                    index, field_name, ids, local_shards, src_call
+                )
+                pairs: Dict[int, int] = {}
+                for ri, row_id in enumerate(ids):
+                    for si in range(len(local_shards)):
+                        cnt = int(row_counts[ri, si])
+                        if cnt <= 0 or cnt < thr:
+                            continue
+                        count = int(inter[ri, si]) if inter is not None else cnt
+                        if count == 0 or count < thr:
+                            continue
+                        pairs[row_id] = pairs.get(row_id, 0) + count
+                return [Pair(id=r, count=n) for r, n in pairs.items()]
+
+        if local_runner is not None:
+            result = self._fan_out(index, shards, c, opt, local_runner, add_pairs) or []
+        else:
+            result = self._map_reduce(index, shards, c, opt, map_fn, add_pairs) or []
         return sort_pairs(result)
 
     def _execute_topn_shard(self, index: str, c: Call, shard: int) -> List[Pair]:

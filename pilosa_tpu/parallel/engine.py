@@ -282,6 +282,57 @@ class ShardedQueryEngine:
         planes = fn(leaves)  # (S_padded, W) sharded
         return Row({shard: planes[i] for i, shard in enumerate(shards)})
 
+    def topn_shard_counts(
+        self, index: str, field: str, row_ids: Sequence[int],
+        shards: Sequence[int], src_call: Optional[Call] = None,
+    ):
+        """Per-(row, shard) count matrices in one device program.
+
+        Returns (row_counts, inter_counts): both (R, S) int arrays;
+        inter_counts is None without a src call. Per-shard granularity
+        preserves the reference's per-shard MinThreshold semantics
+        (fragment.go:899-990) while batching all popcounts.
+        """
+        shards = tuple(shards)
+        leaves = [Leaf(field, VIEW_STANDARD, r) for r in row_ids]
+        rows_tensor = self._leaf_tensor(index, leaves, shards)
+        s_real = len(shards)
+        if src_call is not None:
+            comp, expr = self._compile(index, src_call)
+            src_leaves = self._leaf_tensor(index, comp.leaves, shards)
+            sig = ("topn_shard_src", tuple(comp.signature), len(shards), len(row_ids))
+            fn = self._count_fns.get(sig)
+            if fn is None:
+                @jax.jit
+                def fn(rows, src_lv):
+                    stacked = jnp.stack(rows)  # (R, S, W)
+                    row_counts = jnp.sum(
+                        jax.lax.population_count(stacked).astype(jnp.int32), axis=2
+                    )
+                    src = expr(src_lv)
+                    masked = jnp.bitwise_and(stacked, src[None, :, :])
+                    inter = jnp.sum(
+                        jax.lax.population_count(masked).astype(jnp.int32), axis=2
+                    )
+                    return row_counts, inter
+
+                self._count_fns[sig] = fn
+            row_counts, inter = fn(rows_tensor, src_leaves)
+            return np.asarray(row_counts)[:, :s_real], np.asarray(inter)[:, :s_real]
+
+        sig = ("topn_shard", len(shards), len(row_ids))
+        fn = self._count_fns.get(sig)
+        if fn is None:
+            @jax.jit
+            def fn(rows):
+                stacked = jnp.stack(rows)
+                return jnp.sum(
+                    jax.lax.population_count(stacked).astype(jnp.int32), axis=2
+                )
+
+            self._count_fns[sig] = fn
+        return np.asarray(fn(rows_tensor))[:, :s_real], None
+
     def topn_counts(
         self, index: str, field: str, row_ids: Sequence[int],
         shards: Sequence[int], src_call: Optional[Call] = None,
@@ -321,6 +372,75 @@ class ShardedQueryEngine:
 
             self._count_fns[sig] = fn
         return np.asarray(fn(rows_tensor))
+
+    def bsi_val_count(
+        self, index: str, field: str, kind: str, bit_depth: int,
+        shards: Sequence[int], filter_call: Optional[Call] = None,
+    ):
+        """Batched BSI Sum/Min/Max across all shards in one device program.
+
+        kind='sum' returns (depth+1,) per-plane global counts (host composes
+        the weighted sum in Python ints). kind='min'/'max' returns
+        (bits (depth,), count) — the bit-sliced scan of fragment.go:603-657
+        run over the full sharded plane set, so cross-shard min/max needs no
+        per-shard ValCount merge.
+        """
+        shards = tuple(shards)
+        view = VIEW_BSI_GROUP_PREFIX + field
+        leaves = [Leaf(field, view, i) for i in range(bit_depth + 1)]
+        planes = self._leaf_tensor(index, leaves, shards)
+        filter_leaves = None
+        fsig = ()
+        expr = None
+        if filter_call is not None:
+            comp, expr = self._compile(index, filter_call)
+            filter_leaves = self._leaf_tensor(index, comp.leaves, shards)
+            fsig = tuple(comp.signature)
+        sig = ("bsi", kind, bit_depth, len(shards), fsig)
+        fn = self._count_fns.get(sig)
+        if fn is None:
+            def total(x):
+                return jnp.sum(jax.lax.population_count(x).astype(jnp.int32))
+
+            if kind == "sum":
+                @jax.jit
+                def fn(planes, flt):
+                    stacked = jnp.stack(planes)  # (D+1, S, W)
+                    if expr is not None:
+                        stacked = jnp.bitwise_and(stacked, expr(flt)[None])
+                    return jnp.sum(
+                        jax.lax.population_count(stacked).astype(jnp.int32),
+                        axis=(1, 2),
+                    )
+            else:
+                maximize = kind == "max"
+
+                @jax.jit
+                def fn(planes, flt):
+                    consider = planes[bit_depth]
+                    if expr is not None:
+                        consider = jnp.bitwise_and(consider, expr(flt))
+                    bits = []
+                    for i in range(bit_depth - 1, -1, -1):
+                        if maximize:
+                            x = jnp.bitwise_and(planes[i], consider)
+                        else:
+                            x = jnp.bitwise_and(consider, jnp.bitwise_not(planes[i]))
+                        nonzero = total(x) > 0
+                        bit = jnp.where(nonzero, 1, 0) if maximize else jnp.where(nonzero, 0, 1)
+                        bits.append(bit.astype(jnp.int32))
+                        consider = jnp.where(nonzero, x, consider)
+                    bits = (
+                        jnp.stack(bits[::-1]) if bits else jnp.zeros((0,), jnp.int32)
+                    )
+                    return bits, total(consider)
+
+            self._count_fns[sig] = fn
+        out = fn(planes, filter_leaves)
+        if kind == "sum":
+            return np.asarray(out)
+        bits, count = out
+        return np.asarray(bits), int(count)
 
     def supports(self, call: Call) -> bool:
         """True if `call` compiles onto the fast path."""
