@@ -259,8 +259,7 @@ class API:
         self.server.broadcast_message({"type": "set-coordinator", "nodeID": node_id})
 
     def remove_node(self, node_id: str) -> None:
-        self.cluster.remove_node(node_id)
-        self.server.broadcast_message({"type": "remove-node", "nodeID": node_id})
+        self.server.handle_node_leave(node_id)
 
     def translate_data(self, offset: int) -> bytes:
         store = self.server.translate_store

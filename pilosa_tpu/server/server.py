@@ -54,6 +54,7 @@ class Server:
         diagnostics_interval: float = 0.0,
         diagnostics_endpoint: str = "",
         member_monitor_interval: float = 2.0,
+        join_addr: Optional[str] = None,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -67,8 +68,12 @@ class Server:
         self.metric_poll_interval = metric_poll_interval
         self.primary_translate_store_url = primary_translate_store_url
 
+        self.join_addr = join_addr
         self.node_id = node_id or self._load_node_id()
-        self.node = Node(id=self.node_id, uri=f"{host}:{port}", is_coordinator=is_coordinator)
+        self.node = Node(
+            id=self.node_id, uri=f"{host}:{port}",
+            is_coordinator=is_coordinator and join_addr is None,
+        )
         self.cluster = Cluster(
             node=self.node, replica_n=replica_n, hasher=hasher
         )
@@ -168,7 +173,90 @@ class Server:
             self._spawn(self._monitor_members, self.member_monitor_interval)
         self.topology.save(self.cluster.nodes)
         self.opened = True
+        if self.join_addr:
+            self._join_cluster()
         return self
+
+    def _join_cluster(self) -> None:
+        """Join an existing cluster (the reference's gossip join event,
+        cluster.go:1615 ReceiveEvent -> nodeJoin). In static mode node id ==
+        uri; the coordinator admits us (triggering a resize if data exists)
+        and broadcasts the new cluster status."""
+        self.node.id = self.node.uri
+        self.node_id = self.node.uri
+        self.cluster.nodes = [self.node]
+        self.client.send_message(
+            Node(id=self.join_addr, uri=self.join_addr),
+            {"type": "node-join", "node": self.node.to_dict()},
+        )
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (
+                len(self.cluster.nodes) > 1
+                and self.cluster.state == STATE_NORMAL
+                and self.cluster.node_by_id(self.node.id)
+            ):
+                return
+            time.sleep(0.05)
+        raise PilosaError(f"timed out joining cluster via {self.join_addr}")
+
+    def handle_node_join(self, node: Node) -> None:
+        """Coordinator-side admission (cluster.go:1638 nodeJoin)."""
+        if not self.node.is_coordinator:
+            coordinator = self.cluster.coordinator_node()
+            if coordinator is None:
+                raise PilosaError("no coordinator to forward join to")
+            self.client.send_message(
+                coordinator, {"type": "node-join", "node": node.to_dict()}
+            )
+            return
+        if self.cluster.node_by_id(node.id) is not None:
+            # Already a member: re-send the cluster status (idempotent join).
+            self.client.send_message(node, self._status_message())
+            return
+        new_nodes = sorted(self.cluster.nodes + [node], key=lambda n: n.id)
+        self._retopologize(new_nodes, extra_recipients=[node])
+
+    def handle_node_leave(self, node_id: str) -> None:
+        """Coordinator-side removal (api.go:777 RemoveNode): shards the
+        leaving node exclusively held are re-fetched by new owners before
+        the status flips (it stays reachable as a source during the job)."""
+        if not self.node.is_coordinator:
+            coordinator = self.cluster.coordinator_node()
+            if coordinator is None:
+                raise PilosaError("no coordinator to forward leave to")
+            self.client.send_message(
+                coordinator, {"type": "node-leave", "nodeID": node_id}
+            )
+            return
+        if self.cluster.node_by_id(node_id) is None:
+            return
+        new_nodes = [n for n in self.cluster.nodes if n.id != node_id]
+        self._retopologize(new_nodes)
+
+    def _retopologize(self, new_nodes: List[Node], extra_recipients=()) -> None:
+        """Apply a membership change: resize job when data exists, plain
+        status broadcast otherwise."""
+        if self.holder.indexes:
+            from ..cluster.resize import ResizeCoordinator
+
+            if self.resize_coordinator is None:
+                self.resize_coordinator = ResizeCoordinator(self)
+            self.resize_coordinator.begin(new_nodes)
+        else:
+            self.cluster.nodes = list(new_nodes)
+            self.topology.save(self.cluster.nodes)
+            self.broadcast_message(self._status_message())
+            for node in extra_recipients:
+                if all(n.id != node.id for n in self.cluster.nodes):
+                    self.client.send_message(node, self._status_message())
+
+    def _status_message(self) -> dict:
+        return {
+            "type": "cluster-status",
+            "state": self.cluster.state,
+            "nodes": [n.to_dict() for n in self.cluster.nodes],
+        }
 
     def close(self) -> None:
         self._stop.set()
@@ -332,6 +420,10 @@ class Server:
             from ..cluster.resize import mark_resize_instruction_complete
 
             mark_resize_instruction_complete(self, msg)
+        elif typ == "node-join":
+            self.handle_node_join(Node.from_dict(msg["node"]))
+        elif typ == "node-leave":
+            self.handle_node_leave(msg["nodeID"])
         elif typ == "node-state":
             pass  # coordinator bookkeeping; static clusters are always NORMAL
         else:

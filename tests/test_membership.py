@@ -1,0 +1,138 @@
+"""Elastic membership: dynamic node join (with and without data) and
+coordinator-driven node removal (model: reference server/cluster_test.go
+ClusterResize_AddNode / RemoveNode)."""
+
+import socket
+import time
+
+import pytest
+
+from pilosa_tpu.cluster.hash import ModHasher
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.server.client import InternalClient
+from pilosa_tpu.server.server import Server
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def make_server(tmp_path, name, port, **kw):
+    kw.setdefault("cache_flush_interval", 0)
+    kw.setdefault("member_monitor_interval", 0)
+    kw.setdefault("executor_workers", 0)
+    kw.setdefault("hasher", ModHasher())
+    s = Server(data_dir=str(tmp_path / name), port=port, **kw)
+    s.open()
+    return s
+
+
+def wait_for(cond, timeout=10):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_join_empty_cluster(tmp_path):
+    """A node joins a single-node cluster with no data: status-only path."""
+    port0 = free_port()
+    s0 = make_server(tmp_path, "n0", port0, cluster_hosts=[f"localhost:{port0}"])
+    servers = [s0]
+    try:
+        s1 = make_server(tmp_path, "n1", free_port(), join_addr=s0.node.uri)
+        servers.append(s1)
+        assert len(s1.cluster.nodes) == 2
+        assert wait_for(lambda: len(s0.cluster.nodes) == 2)
+        assert {n.id for n in s0.cluster.nodes} == {s0.node.id, s1.node.id}
+        # Schema created after the join propagates to both.
+        client = InternalClient()
+        client.create_index(s0.node.uri, "j")
+        client.create_field(s0.node.uri, "j", "f")
+        assert wait_for(lambda: s1.holder.field("j", "f") is not None)
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_join_with_data_triggers_resize(tmp_path):
+    port0 = free_port()
+    s0 = make_server(tmp_path, "n0", port0, cluster_hosts=[f"localhost:{port0}"])
+    servers = [s0]
+    client = InternalClient()
+    try:
+        client.create_index(s0.node.uri, "jd")
+        client.create_field(s0.node.uri, "jd", "f")
+        cols = [1, SHARD_WIDTH + 2, 2 * SHARD_WIDTH + 3]
+        for col in cols:
+            client.query(s0.node.uri, "jd", f"Set({col}, f=1)")
+
+        s1 = make_server(tmp_path, "n1", free_port(), join_addr=s0.node.uri)
+        servers.append(s1)
+        assert wait_for(lambda: len(s0.cluster.nodes) == 2 and s0.cluster.state == "NORMAL")
+        # Schema moved to the new node and it holds the shards it now owns.
+        assert s1.holder.field("jd", "f") is not None
+        owned = [
+            sh for sh in range(3)
+            if any(n.id == s1.node.id for n in s0.cluster.shard_nodes("jd", sh))
+        ]
+        for sh in owned:
+            assert s1.holder.fragment("jd", "f", "standard", sh) is not None, sh
+        # Full query still answers from either node.
+        for s in servers:
+            assert client.query(s.node.uri, "jd", "Count(Row(f=1))")["results"][0] == 3
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_remove_node(tmp_path):
+    ports = [free_port() for _ in range(3)]
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [
+        make_server(tmp_path, f"n{i}", ports[i], cluster_hosts=hosts)
+        for i in range(3)
+    ]
+    client = InternalClient()
+    try:
+        h0 = servers[0].node.uri
+        client.create_index(h0, "rm")
+        client.create_field(h0, "rm", "f")
+        time.sleep(0.05)
+        cols = [1, SHARD_WIDTH + 2, 2 * SHARD_WIDTH + 3, 3 * SHARD_WIDTH + 4]
+        for col in cols:
+            client.query(h0, "rm", f"Set({col}, f=1)")
+
+        # Remove a non-coordinator node through the public endpoint.
+        victim = servers[2]
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{h0}/cluster/resize/remove-node",
+            data=json.dumps({"id": victim.node.id}).encode(),
+            method="POST",
+        )
+        urllib.request.urlopen(req)
+        assert wait_for(
+            lambda: len(servers[0].cluster.nodes) == 2
+            and servers[0].cluster.state == "NORMAL"
+        )
+        assert all(n.id != victim.node.id for n in servers[0].cluster.nodes)
+        victim.close()
+        # All data still answerable from the remaining nodes.
+        assert client.query(h0, "rm", "Count(Row(f=1))")["results"][0] == 4
+        row = client.query(servers[1].node.uri, "rm", "Row(f=1)")
+        assert row["results"][0]["columns"] == cols
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
