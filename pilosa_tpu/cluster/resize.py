@@ -120,6 +120,7 @@ class ResizeCoordinator:
                 "schema": schema,
                 "sources": instr_sources,
                 "nodeURIs": node_uris,
+                "maxShards": max_shards,
             }
             if node_id == cluster.node.id:
                 follow_resize_instruction(self.server, msg)
@@ -152,6 +153,10 @@ class ResizeCoordinator:
 def follow_resize_instruction(server, msg: dict) -> None:
     """Receiver side (cluster.go:1179 followResizeInstruction)."""
     server.holder.apply_schema(msg.get("schema", []))
+    for index_name, max_shard in msg.get("maxShards", {}).items():
+        idx = server.holder.index(index_name)
+        if idx is not None:
+            idx.set_remote_max_shard(max_shard)
     node_uris = msg.get("nodeURIs", {})
     for src in msg.get("sources", []):
         source_uri = node_uris.get(src["sourceNodeID"])

@@ -47,6 +47,11 @@ class Index:
         self.stats = stats
         self.broadcast_shard = broadcast_shard
         self.fields: Dict[str, Field] = {}
+        # Highest shard known to exist cluster-wide, even if not held
+        # locally (reference index.go:231-255 remoteMaxShard, synced via
+        # gossip NodeStatus; here via create-shard broadcasts, resize
+        # instructions and heartbeat probes).
+        self.remote_max_shard = 0
         self._lock = threading.RLock()
         if path:
             self.column_attr_store = AttrStore(os.path.join(path, ".data"))
@@ -133,7 +138,12 @@ class Index:
         return sorted(self.fields)
 
     def max_shard(self) -> int:
-        return max((f.max_shard() for f in self.fields.values()), default=0)
+        local = max((f.max_shard() for f in self.fields.values()), default=0)
+        return max(local, self.remote_max_shard)
+
+    def set_remote_max_shard(self, shard: int) -> None:
+        if shard > self.remote_max_shard:
+            self.remote_max_shard = shard
 
     def available_shards(self) -> List[int]:
         shards = set()

@@ -208,6 +208,13 @@ class Executor:
     def _fan_out(self, index, shards, c, opt, local_runner, reduce_fn):
         from .server.client import ClientError
 
+        # A remote (forwarded) execution runs EXACTLY the shards it was
+        # handed — no ownership re-check (executor.go:1476-1480). The
+        # coordinator chose them; re-deriving placement here would silently
+        # drop shards whenever membership views differ mid-transition.
+        if opt.remote:
+            return local_runner(list(shards)) if shards else None
+
         result = None
         failed: set = set()
         pending = list(shards)

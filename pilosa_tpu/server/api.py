@@ -212,6 +212,8 @@ class API:
             "state": self.cluster.state,
             "nodes": [n.to_dict() for n in self.cluster.nodes],
             "localID": self.cluster.node.id,
+            # NodeStatus payload (reference gossip.go:240-273 push/pull sync).
+            "maxShards": self.shards_max(),
         }
 
     def info(self) -> dict:

@@ -315,7 +315,7 @@ class Server:
             if node.id == self.node.id:
                 continue
             try:
-                self._probe_client.status(node.uri)
+                status = self._probe_client.status(node.uri)
             except PilosaError:
                 if node.id not in self.cluster.unavailable:
                     self.logger.info("node %s marked unavailable", node.id)
@@ -324,6 +324,11 @@ class Server:
                 if node.id in self.cluster.unavailable:
                     self.logger.info("node %s recovered", node.id)
                 self.cluster.mark_available(node.id)
+                # Merge the peer's max-shard view (gossip push/pull sync).
+                for index_name, max_shard in status.get("maxShards", {}).items():
+                    idx = self.holder.index(index_name)
+                    if idx is not None:
+                        idx.set_remote_max_shard(max_shard)
 
     def _monitor_translate_replication(self) -> None:
         data = self.client.translate_data(
@@ -386,6 +391,9 @@ class Server:
                 view = fld.create_view_if_not_exists(msg.get("view", "standard"))
                 # broadcast=False: applying a peer's message must not echo it.
                 view.create_fragment_if_not_exists(msg["shard"], broadcast=False)
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                idx.set_remote_max_shard(msg["shard"])
         elif typ == "schema":
             self.holder.apply_schema(msg["schema"])
         elif typ == "cluster-status":
