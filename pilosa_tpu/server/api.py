@@ -162,11 +162,8 @@ class API:
         for node in self.cluster.shard_nodes(index, shard):
             if node.id == self.cluster.node.id:
                 ts = None
-                if timestamps is not None and any(t is not None for t in timestamps):
-                    ts = [
-                        datetime.strptime(t, "%Y-%m-%dT%H:%M") if isinstance(t, str) else t
-                        for t in timestamps
-                    ]
+                if timestamps is not None and any(t for t in timestamps):
+                    ts = [_to_datetime(t) for t in timestamps]
                 fld.import_bits(row_ids, column_ids, ts)
             elif not remote:
                 self.server.client.import_node(
@@ -289,6 +286,18 @@ class API:
             if remote.get(bid) != chk:
                 out.update(store.block_data(bid))
         return out
+
+
+def _to_datetime(t):
+    """Timestamp from wire: RFC3339-minute string (JSON) or epoch
+    nanoseconds (protobuf ImportRequest.Timestamps)."""
+    if t is None or t == 0:
+        return None
+    if isinstance(t, str):
+        return datetime.strptime(t, "%Y-%m-%dT%H:%M")
+    if isinstance(t, (int, float)):
+        return datetime.utcfromtimestamp(t / 1e9)
+    return t
 
 
 def serialize_result(r) -> Any:

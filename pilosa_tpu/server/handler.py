@@ -100,8 +100,10 @@ class Handler:
             Route("POST", r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/attr/diff", self.handle_field_attr_diff),
         ]
 
-    def dispatch(self, method: str, path: str, query: Dict[str, List[str]], body: bytes):
+    def dispatch(self, method: str, path: str, query: Dict[str, List[str]], body: bytes,
+                 headers: Optional[Dict[str, str]] = None):
         """Returns (status, content_type, payload_bytes)."""
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
         for route in self.routes:
             if route.method != method:
                 continue
@@ -110,7 +112,7 @@ class Handler:
                 continue
             try:
                 start = time.monotonic()
-                result = route.fn(query=query, body=body, **m.groupdict())
+                result = route.fn(query=query, body=body, headers=headers, **m.groupdict())
                 elapsed = time.monotonic() - start
                 lqt = getattr(self.api.server, "long_query_time", 0)
                 if lqt and elapsed > lqt and self.logger:
@@ -163,8 +165,19 @@ class Handler:
         self.api.delete_field(index, field)
         return {}
 
-    def handle_post_import(self, index, field, body, **kw):
-        req = json.loads(body)
+    def handle_post_import(self, index, field, body, headers=None, **kw):
+        headers = headers or {}
+        if "application/x-protobuf" in headers.get("content-type", ""):
+            from . import proto
+            from ..constants import FIELD_TYPE_INT
+
+            fld = self.api.holder.field(index, field)
+            if fld is not None and fld.type() == FIELD_TYPE_INT:
+                req = proto.decode_import_value_request(body)
+            else:
+                req = proto.decode_import_request(body)
+        else:
+            req = json.loads(body)
         shard = req.get("shard", 0)
         if "values" in req:
             self.api.import_values(
@@ -178,21 +191,55 @@ class Handler:
             )
         return {}
 
-    def handle_post_query(self, index, body, query, **kw):
-        body_text = body.decode() if body else ""
+    def handle_post_query(self, index, body, query, headers=None, **kw):
+        headers = headers or {}
+        wants_proto = "application/x-protobuf" in headers.get("accept", "")
+        is_proto = "application/x-protobuf" in headers.get("content-type", "")
         shards = None
         remote = query.get("remote", ["false"])[0] == "true"
-        if body_text.startswith("{"):
-            req = json.loads(body_text)
-            pql = req.get("query", "")
-            shards = req.get("shards")
-        else:
-            pql = body_text
-        if "shards" in query:
-            shards = [int(s) for s in query["shards"][0].split(",")]
         column_attrs = query.get("columnAttrs", ["false"])[0] == "true"
         exclude_row_attrs = query.get("excludeRowAttrs", ["false"])[0] == "true"
         exclude_columns = query.get("excludeColumns", ["false"])[0] == "true"
+
+        if is_proto:
+            from . import proto
+
+            req = proto.decode_query_request(body)
+            pql = req["query"]
+            shards = req["shards"]
+            remote = remote or req["remote"]
+            column_attrs = column_attrs or req["columnAttrs"]
+            exclude_row_attrs = exclude_row_attrs or req["excludeRowAttrs"]
+            exclude_columns = exclude_columns or req["excludeColumns"]
+        else:
+            body_text = body.decode() if body else ""
+            if body_text.startswith("{"):
+                req = json.loads(body_text)
+                pql = req.get("query", "")
+                shards = req.get("shards")
+            else:
+                pql = body_text
+        if "shards" in query:
+            shards = [int(s) for s in query["shards"][0].split(",")]
+
+        if wants_proto:
+            from . import proto
+            from ..errors import PilosaError
+
+            try:
+                results = self.api.query(
+                    index, pql, shards=shards, remote=remote,
+                    exclude_row_attrs=exclude_row_attrs,
+                    exclude_columns=exclude_columns,
+                )
+            except PilosaError as e:
+                return 400, "application/x-protobuf", proto.encode_query_response([], err=str(e))
+            cas = None
+            if column_attrs:
+                cas = self._column_attr_sets(index, results)
+            payload = proto.encode_query_response(results, cas)
+            return 200, "application/x-protobuf", payload
+
         if remote:
             results = self.api.query(index, pql, shards=shards, remote=True)
             return {"results": [serialize_remote(r) for r in results]}
@@ -200,6 +247,19 @@ class Handler:
             index, pql, shards=shards, column_attrs=column_attrs,
             exclude_row_attrs=exclude_row_attrs, exclude_columns=exclude_columns,
         )
+
+    def _column_attr_sets(self, index, results):
+        cols = set()
+        for r in results:
+            if isinstance(r, Row):
+                cols.update(int(c) for c in r.columns())
+        idx = self.api.holder.index(index)
+        out = []
+        for col in sorted(cols):
+            a = idx.column_attr_store.attrs(col)
+            if a:
+                out.append({"id": col, "attrs": a})
+        return out
 
     def handle_get_export(self, query, **kw):
         index = query["index"][0]
@@ -313,7 +373,8 @@ class _RequestHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
         status, ctype, payload = self.handler.dispatch(
-            method, parsed.path.rstrip("/") or "/", parse_qs(parsed.query), body
+            method, parsed.path.rstrip("/") or "/", parse_qs(parsed.query), body,
+            headers=dict(self.headers),
         )
         self.send_response(status)
         self.send_header("Content-Type", ctype)

@@ -1,0 +1,167 @@
+"""Protobuf wire format tests: content negotiation on /query and /import,
+message compatibility with the reference's public.proto field layout."""
+
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.server.proto import (
+    decode_query_response,
+    encode_query_response,
+    public_pb2 as pb,
+)
+from pilosa_tpu.server.server import Server
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = Server(data_dir=str(tmp_path / "srv"), cache_flush_interval=0)
+    s.open()
+    yield s
+    s.close()
+
+
+def _post(url, body, content_type=None, accept=None):
+    req = urllib.request.Request(url, data=body, method="POST")
+    if content_type:
+        req.add_header("Content-Type", content_type)
+    if accept:
+        req.add_header("Accept", accept)
+    with urllib.request.urlopen(req) as resp:
+        return resp.read(), resp.headers.get("Content-Type")
+
+
+def test_proto_query_roundtrip(server):
+    host = f"localhost:{server.port}"
+    _post(f"http://{host}/index/p", b"{}")
+    _post(f"http://{host}/index/p/field/f", b"{}")
+    _post(f"http://{host}/index/p/query", b"Set(1, f=10) Set(2, f=10)")
+
+    req = pb.QueryRequest()
+    req.Query = "Row(f=10) Count(Row(f=10)) TopN(f, n=1)"
+    data, ctype = _post(
+        f"http://{host}/index/p/query",
+        req.SerializeToString(),
+        content_type="application/x-protobuf",
+        accept="application/x-protobuf",
+    )
+    assert ctype == "application/x-protobuf"
+    err, results = decode_query_response(data)
+    assert err == ""
+    row, count, pairs = results
+    assert list(row.columns()) == [1, 2]
+    assert count == 2
+    assert [(p.id, p.count) for p in pairs] == [(10, 2)]
+
+
+def test_proto_query_shards_restriction(server):
+    from pilosa_tpu.constants import SHARD_WIDTH
+
+    host = f"localhost:{server.port}"
+    _post(f"http://{host}/index/ps", b"{}")
+    _post(f"http://{host}/index/ps/field/f", b"{}")
+    _post(f"http://{host}/index/ps/query",
+          f"Set(1, f=1) Set({SHARD_WIDTH + 1}, f=1)".encode())
+    req = pb.QueryRequest()
+    req.Query = "Count(Row(f=1))"
+    req.Shards.extend([0])
+    data, _ = _post(
+        f"http://{host}/index/ps/query", req.SerializeToString(),
+        content_type="application/x-protobuf", accept="application/x-protobuf",
+    )
+    _, results = decode_query_response(data)
+    assert results[0] == 1  # only shard 0 counted
+
+
+def test_proto_import(server):
+    host = f"localhost:{server.port}"
+    _post(f"http://{host}/index/pi", b"{}")
+    _post(f"http://{host}/index/pi/field/f", b"{}")
+    req = pb.ImportRequest()
+    req.Index = "pi"
+    req.Field = "f"
+    req.Shard = 0
+    req.RowIDs.extend([1, 1, 2])
+    req.ColumnIDs.extend([10, 20, 30])
+    _post(
+        f"http://{host}/index/pi/field/f/import",
+        req.SerializeToString(),
+        content_type="application/x-protobuf",
+    )
+    data, _ = _post(f"http://{host}/index/pi/query", b"Row(f=1)")
+    import json
+
+    assert json.loads(data)["results"][0]["columns"] == [10, 20]
+
+
+def test_proto_import_values(server):
+    host = f"localhost:{server.port}"
+    _post(f"http://{host}/index/pv", b"{}")
+    _post(f"http://{host}/index/pv/field/v",
+          b'{"options": {"type": "int", "min": 0, "max": 1000}}')
+    req = pb.ImportValueRequest()
+    req.Index = "pv"
+    req.Field = "v"
+    req.Shard = 0
+    req.ColumnIDs.extend([1, 2])
+    req.Values.extend([100, 200])
+    _post(
+        f"http://{host}/index/pv/field/v/import",
+        req.SerializeToString(),
+        content_type="application/x-protobuf",
+    )
+    data, _ = _post(f"http://{host}/index/pv/query", b"Sum(field=v)")
+    import json
+
+    assert json.loads(data)["results"][0] == {"value": 300, "count": 2}
+
+
+def test_proto_attrs_roundtrip(server):
+    host = f"localhost:{server.port}"
+    _post(f"http://{host}/index/pa", b"{}")
+    _post(f"http://{host}/index/pa/field/f", b"{}")
+    _post(f"http://{host}/index/pa/query",
+          b'Set(1, f=3) SetRowAttrs(f, 3, color="red", n=7, active=true)')
+    req = pb.QueryRequest()
+    req.Query = "Row(f=3)"
+    data, _ = _post(
+        f"http://{host}/index/pa/query", req.SerializeToString(),
+        content_type="application/x-protobuf", accept="application/x-protobuf",
+    )
+    _, results = decode_query_response(data)
+    assert results[0].attrs == {"color": "red", "n": 7, "active": True}
+
+
+def test_proto_error_response(server):
+    host = f"localhost:{server.port}"
+    req = pb.QueryRequest()
+    req.Query = "Row(f=1)"
+    r = urllib.request.Request(
+        f"http://{host}/index/nosuch/query", data=req.SerializeToString(),
+        method="POST",
+    )
+    r.add_header("Content-Type", "application/x-protobuf")
+    r.add_header("Accept", "application/x-protobuf")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(r)
+    err, results = decode_query_response(ei.value.read())
+    assert "not found" in err
+
+
+def test_encode_decode_helpers():
+    from pilosa_tpu.core.cache import Pair
+    from pilosa_tpu.core.row import Row
+    from pilosa_tpu.executor import ValCount
+
+    row = Row(columns=[1, 5])
+    row.attrs = {"x": 1.5}
+    results = [row, 7, True, [Pair(id=3, count=9, key="k")], ValCount(10, 2), None]
+    err, decoded = decode_query_response(encode_query_response(results))
+    assert err == ""
+    assert list(decoded[0].columns()) == [1, 5]
+    assert decoded[0].attrs == {"x": 1.5}
+    assert decoded[1] == 7
+    assert decoded[2] is True
+    assert decoded[3][0].key == "k"
+    assert decoded[4].val == 10
+    assert decoded[5] is None
