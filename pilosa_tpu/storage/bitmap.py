@@ -53,6 +53,23 @@ def _empty() -> np.ndarray:
     return np.empty(0, dtype=np.uint16)
 
 
+# Pluggable container-store backend (the reference's Containers interface,
+# roaring.go:66-99). Default is a plain dict; the B+tree store
+# (btree_containers.BTreeContainers) can be swapped in globally — the
+# equivalent of the enterprise build-tag swap
+# `roaring.NewFileBitmap = b.NewBTreeBitmap` (enterprise/enterprise.go:31).
+_CONTAINER_FACTORY = dict
+
+
+def set_container_factory(factory) -> None:
+    global _CONTAINER_FACTORY
+    _CONTAINER_FACTORY = factory
+
+
+def get_container_factory():
+    return _CONTAINER_FACTORY
+
+
 class Bitmap:
     """Sorted-container bitmap over uint64 values."""
 
@@ -60,7 +77,7 @@ class Bitmap:
 
     def __init__(self, values=None):
         # key (value >> 16) -> sorted unique np.uint16 array of low bits
-        self.containers: Dict[int, np.ndarray] = {}
+        self.containers = _CONTAINER_FACTORY()
         self.op_n = 0
         if values is not None:
             self.add_many(np.asarray(values, dtype=np.uint64))
@@ -200,7 +217,8 @@ class Bitmap:
 
     def clone(self) -> "Bitmap":
         b = Bitmap()
-        b.containers = {k: c.copy() for k, c in self.containers.items()}
+        for k, c in self.containers.items():
+            b.containers[k] = c.copy()
         return b
 
     # ------------------------------------------------------ set algebra (oracle)
