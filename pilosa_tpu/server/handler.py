@@ -56,6 +56,19 @@ def deserialize_remote(d: dict):
     return None
 
 
+def _json_body(body: bytes, default=None) -> dict:
+    """Parse a JSON request body; malformed input is a client error (400),
+    not an internal one."""
+    if not body:
+        if default is not None:
+            return default
+        raise PilosaError("request body required")
+    try:
+        return json.loads(body)
+    except json.JSONDecodeError as e:
+        raise PilosaError(f"malformed JSON body: {e}") from None
+
+
 class Route:
     def __init__(self, method: str, pattern: str, fn: Callable):
         self.method = method
@@ -98,6 +111,9 @@ class Handler:
             Route("GET", r"/internal/translate/data", self.handle_translate_data),
             Route("POST", r"/internal/index/(?P<index>[^/]+)/attr/diff", self.handle_index_attr_diff),
             Route("POST", r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/attr/diff", self.handle_field_attr_diff),
+            Route("GET", r"/debug/vars", self.handle_debug_vars),
+            Route("POST", r"/debug/profile", self.handle_debug_profile),
+            Route("GET", r"/internal/diagnostics", self.handle_diagnostics),
         ]
 
     def dispatch(self, method: str, path: str, query: Dict[str, List[str]], body: bytes,
@@ -150,7 +166,7 @@ class Handler:
         raise IndexNotFoundError(index)
 
     def handle_post_index(self, index, body, **kw):
-        opts = json.loads(body or b"{}").get("options", {})
+        opts = _json_body(body, default={}).get("options", {})
         return self.api.create_index(index, opts)
 
     def handle_delete_index(self, index, **kw):
@@ -158,7 +174,7 @@ class Handler:
         return {}
 
     def handle_post_field(self, index, field, body, **kw):
-        opts = json.loads(body or b"{}").get("options", {})
+        opts = _json_body(body, default={}).get("options", {})
         return self.api.create_field(index, field, opts)
 
     def handle_delete_field(self, index, field, **kw):
@@ -177,7 +193,7 @@ class Handler:
             else:
                 req = proto.decode_import_request(body)
         else:
-            req = json.loads(body)
+            req = _json_body(body)
         shard = req.get("shard", 0)
         if "values" in req:
             self.api.import_values(
@@ -214,7 +230,7 @@ class Handler:
         else:
             body_text = body.decode() if body else ""
             if body_text.startswith("{"):
-                req = json.loads(body_text)
+                req = _json_body(body)
                 pql = req.get("query", "")
                 shards = req.get("shards")
             else:
@@ -288,17 +304,17 @@ class Handler:
         return {}
 
     def handle_remove_node(self, body, **kw):
-        req = json.loads(body or b"{}")
+        req = _json_body(body, default={})
         self.api.remove_node(req.get("id", ""))
         return {}
 
     def handle_set_coordinator(self, body, **kw):
-        req = json.loads(body or b"{}")
+        req = _json_body(body, default={})
         self.api.set_coordinator(req.get("id", ""))
         return {}
 
     def handle_cluster_message(self, body, **kw):
-        self.api.cluster_message(json.loads(body))
+        self.api.cluster_message(_json_body(body))
         return {}
 
     def handle_fragment_blocks(self, query, **kw):
@@ -353,13 +369,55 @@ class Handler:
         offset = int(query.get("offset", ["0"])[0])
         return 200, "application/octet-stream", self.api.translate_data(offset)
 
+    def handle_debug_vars(self, **kw):
+        """expvar equivalent (reference mounts /debug/vars,
+        http/handler.go:196): stats counters/gauges/timings as JSON."""
+        stats = self.api.server.stats
+        if hasattr(stats, "snapshot"):
+            return stats.snapshot()
+        return {}
+
+    _profile_lock = threading.Lock()
+
+    def handle_debug_profile(self, query, **kw):
+        """Capture a JAX profiler trace (the pprof-equivalent for the
+        device hot path). POST /debug/profile?seconds=2 writes a trace
+        under <data_dir>/profiles and returns its path. The profiler is
+        process-global: concurrent captures are rejected with 409."""
+        import os
+        import uuid
+
+        import jax
+
+        seconds = min(max(float(query.get("seconds", ["1"])[0]), 0.0), 30.0)
+        if not self._profile_lock.acquire(blocking=False):
+            return 409, "application/json", json.dumps(
+                {"error": "a profile capture is already running"}
+            ).encode()
+        try:
+            base = self.api.server.data_dir or "/tmp"
+            out = os.path.join(base, "profiles",
+                               f"{int(time.time())}-{uuid.uuid4().hex[:6]}")
+            os.makedirs(out, exist_ok=True)
+            jax.profiler.start_trace(out)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+        finally:
+            self._profile_lock.release()
+        return {"path": out}
+
+    def handle_diagnostics(self, **kw):
+        return self.api.server.diagnostics.gather()
+
     def handle_index_attr_diff(self, index, body, **kw):
-        req = json.loads(body)
+        req = _json_body(body)
         attrs = self.api.attr_diff(index, None, req.get("blocks", []))
         return {"attrs": {str(k): v for k, v in attrs.items()}}
 
     def handle_field_attr_diff(self, index, field, body, **kw):
-        req = json.loads(body)
+        req = _json_body(body)
         attrs = self.api.attr_diff(index, field, req.get("blocks", []))
         return {"attrs": {str(k): v for k, v in attrs.items()}}
 

@@ -208,3 +208,38 @@ def test_cluster_attr_broadcast(cluster3, client):
     client.query(host(cluster3[0]), "ca", 'SetRowAttrs(f, 1, color="red")')
     for s in cluster3:
         assert s.holder.field("ca", "f").row_attr_store.attrs(1) == {"color": "red"}
+
+
+def test_debug_vars_and_diagnostics(server, client):
+    import json
+    import urllib.request
+
+    client.create_index(host(server), "dv")
+    client.create_field(host(server), "dv", "f")
+    client.query(host(server), "dv", "Set(1, f=1)")
+    with urllib.request.urlopen(f"http://{host(server)}/debug/vars") as resp:
+        snap = json.loads(resp.read())
+    assert "counters" in snap and snap["counters"].get("setBit", 0) >= 1
+    with urllib.request.urlopen(f"http://{host(server)}/internal/diagnostics") as resp:
+        diag = json.loads(resp.read())
+    assert diag["numIndexes"] >= 1 and diag["version"]
+
+
+def test_long_query_logging(tmp_path):
+    from pilosa_tpu.logger import BufferLogger
+    from pilosa_tpu.server.client import InternalClient
+
+    logger = BufferLogger()
+    s = Server(
+        data_dir=str(tmp_path / "lq"), cache_flush_interval=0,
+        long_query_time=0.000001, logger=logger,
+    )
+    s.open()
+    try:
+        c = InternalClient()
+        c.create_index(f"localhost:{s.port}", "lq")
+        c.create_field(f"localhost:{s.port}", "lq", "f")
+        c.query(f"localhost:{s.port}", "lq", "Set(1, f=1)")
+        assert any("long-query-time" in line for _, line in logger.lines)
+    finally:
+        s.close()
