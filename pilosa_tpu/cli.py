@@ -85,11 +85,15 @@ def cmd_import(args) -> int:
                 if not line:
                     continue
                 if args.field_type == "int":
-                    batch.append((int(line[0]), int(line[1])))  # col, value
-                elif len(line) >= 3 and line[2]:
-                    batch.append((int(line[0]), int(line[1]), line[2]))
+                    col = line[0] if args.index_keys else int(line[0])
+                    batch.append((col, int(line[1])))  # col, value
                 else:
-                    batch.append((int(line[0]), int(line[1])))
+                    row = line[0] if args.field_keys else int(line[0])
+                    col = line[1] if args.index_keys else int(line[1])
+                    if len(line) >= 3 and line[2]:
+                        batch.append((row, col, line[2]))
+                    else:
+                        batch.append((row, col))
                 if len(batch) >= args.batch_size:
                     _flush_import(client, args, batch)
                     total += len(batch)
@@ -167,11 +171,17 @@ def cmd_check(args) -> int:
             continue
         try:
             with open(path, "rb") as f:
-                Bitmap.from_bytes(f.read())
-            print(f"{path}: ok")
+                bm = Bitmap.from_bytes(f.read())
         except (ValueError, OSError) as e:
             print(f"{path}: CORRUPT ({e})")
             bad += 1
+            continue
+        problems = bm.check()
+        if problems:
+            print(f"{path}: INCONSISTENT ({'; '.join(problems)})")
+            bad += 1
+        else:
+            print(f"{path}: ok")
     return 1 if bad else 0
 
 

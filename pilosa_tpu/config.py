@@ -191,9 +191,11 @@ class Config:
     def build_server(self, **overrides):
         """Construct a Server from this config."""
         from .server.server import Server
+        from .stats import new_stats_client
 
         host, _, port = self.bind.partition(":")
         kw = dict(
+            stats=new_stats_client(self.metric.service, self.metric.host),
             data_dir=os.path.expanduser(self.data_dir),
             host=host or "localhost",
             port=int(port or 0),

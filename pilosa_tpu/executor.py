@@ -527,7 +527,8 @@ class Executor:
             ids
             and not c.args.get("attrName")
             and not tanimoto
-            and (src_call is None or self.engine.supports(src_call))
+            and src_call is not None  # without src the host rank cache has
+            and self.engine.supports(src_call)  # exact counts; device adds RTT
         ):
             # Batched phase-2: all candidate counts across all local shards
             # in one device program, preserving per-shard MinThreshold

@@ -145,8 +145,14 @@ class API:
     # --------------------------------------------------------------- import
 
     def import_bits(self, index: str, field: str, shard: int, row_ids, column_ids,
-                    timestamps=None, remote: bool = False) -> None:
-        """Route or apply a shard's worth of bits (api.go:653-698)."""
+                    timestamps=None, remote: bool = False,
+                    row_keys=None, column_keys=None) -> None:
+        """Route or apply a shard's worth of bits (api.go:653-698).
+
+        String keys (row_keys/column_keys) are translated to ids here and
+        the bits re-grouped by shard before routing — the key-mode import
+        path (reference api.go key translation + ctl/import.go -k).
+        """
         self._validate("import")
         idx = self.holder.index(index)
         if idx is None:
@@ -158,6 +164,49 @@ class API:
             from ..errors import FieldNotFoundError
 
             raise FieldNotFoundError(field)
+
+        store = self.server.translate_store
+        if row_keys or column_keys:
+            n = len(column_keys) if column_keys else len(column_ids or [])
+            n_rows = len(row_keys) if row_keys else len(row_ids or [])
+            if n != n_rows:
+                raise QueryError(
+                    f"import row/column length mismatch: {n_rows} rows vs {n} columns"
+                )
+            if timestamps is not None and len(timestamps) != n:
+                raise QueryError(
+                    f"import timestamps length mismatch: {len(timestamps)} vs {n}"
+                )
+            if store.read_only:
+                # Key allocation happens on the translation primary
+                # (reference PrimaryTranslateStore); forward the whole
+                # key-mode import there.
+                self.server.client.import_keys_node(
+                    self.server.primary_translate_store_url, index, field,
+                    row_ids, column_ids, row_keys, column_keys, timestamps,
+                )
+                return
+            if column_keys:
+                if not idx.keys():
+                    raise QueryError("column keys require index 'keys' option")
+                column_ids = store.translate_columns_to_uint64(index, list(column_keys))
+            if row_keys:
+                if not fld.keys():
+                    raise QueryError("row keys require field 'keys' option")
+                row_ids = store.translate_rows_to_uint64(index, field, list(row_keys))
+            # Re-group by shard now that column ids are known.
+            by_shard: Dict[int, List[int]] = {}
+            for i, col in enumerate(column_ids):
+                by_shard.setdefault(col // SHARD_WIDTH, []).append(i)
+            for sh, idxs in sorted(by_shard.items()):
+                self.import_bits(
+                    index, field, sh,
+                    [row_ids[i] for i in idxs],
+                    [column_ids[i] for i in idxs],
+                    [timestamps[i] for i in idxs] if timestamps else None,
+                    remote=remote,
+                )
+            return
 
         for node in self.cluster.shard_nodes(index, shard):
             if node.id == self.cluster.node.id:
@@ -171,13 +220,34 @@ class API:
                 )
 
     def import_values(self, index: str, field: str, shard: int, column_ids, values,
-                      remote: bool = False) -> None:
+                      remote: bool = False, column_keys=None) -> None:
         self._validate("import")
+        idx = self.holder.index(index)
         fld = self.holder.field(index, field)
         if fld is None:
             from ..errors import FieldNotFoundError
 
             raise FieldNotFoundError(field)
+        if column_keys:
+            if len(column_keys) != len(values):
+                raise QueryError(
+                    f"import columns/values length mismatch: {len(column_keys)} vs {len(values)}"
+                )
+            if not idx.keys():
+                raise QueryError("column keys require index 'keys' option")
+            column_ids = self.server.translate_store.translate_columns_to_uint64(
+                index, list(column_keys)
+            )
+            by_shard: Dict[int, List[int]] = {}
+            for i, col in enumerate(column_ids):
+                by_shard.setdefault(col // SHARD_WIDTH, []).append(i)
+            for sh, idxs in sorted(by_shard.items()):
+                self.import_values(
+                    index, field, sh,
+                    [column_ids[i] for i in idxs], [values[i] for i in idxs],
+                    remote=remote,
+                )
+            return
         for node in self.cluster.shard_nodes(index, shard):
             if node.id == self.cluster.node.id:
                 fld.import_value(column_ids, values)

@@ -118,6 +118,18 @@ class InternalClient:
         }).encode()
         self._request("POST", f"{_node_url(node)}/index/{index}/field/{field}/import", body)
 
+    def import_keys_node(self, node, index: str, field: str,
+                         row_ids, column_ids, row_keys, column_keys, timestamps) -> None:
+        """Forward a key-mode import to the translation primary."""
+        body = json.dumps({
+            "rowIDs": list(row_ids) if row_ids is not None and not row_keys else None,
+            "columnIDs": list(column_ids) if column_ids is not None and not column_keys else None,
+            "rowKeys": list(row_keys) if row_keys else None,
+            "columnKeys": list(column_keys) if column_keys else None,
+            "timestamps": list(timestamps) if timestamps else None,
+        }).encode()
+        self._request("POST", f"{_node_url(node)}/index/{index}/field/{field}/import", body)
+
     def import_value_node(self, node, index: str, field: str, shard: int,
                           column_ids, values) -> None:
         body = json.dumps({
@@ -130,8 +142,20 @@ class InternalClient:
 
     def import_bits(self, host, index: str, field: str, bits) -> None:
         """Public bulk import: group (row, col) bits by shard and POST each
-        group to an owning node (http/client.go:276 Import)."""
+        group to an owning node (http/client.go:276 Import). Bits with
+        string row/column values go through the key-translation import."""
         from ..constants import SHARD_WIDTH
+
+        if bits and (isinstance(bits[0][0], str) or isinstance(bits[0][1], str)):
+            body = json.dumps({
+                "rowKeys": [b[0] for b in bits] if isinstance(bits[0][0], str) else None,
+                "rowIDs": None if isinstance(bits[0][0], str) else [b[0] for b in bits],
+                "columnKeys": [b[1] for b in bits] if isinstance(bits[0][1], str) else None,
+                "columnIDs": None if isinstance(bits[0][1], str) else [b[1] for b in bits],
+                "timestamps": [b[2] if len(b) > 2 else None for b in bits],
+            }).encode()
+            self._request("POST", f"{_node_url(host)}/index/{index}/field/{field}/import", body)
+            return
 
         by_shard: Dict[int, List] = {}
         for bit in bits:
@@ -151,6 +175,14 @@ class InternalClient:
 
     def import_values(self, host, index: str, field: str, field_values) -> None:
         from ..constants import SHARD_WIDTH
+
+        if field_values and isinstance(field_values[0][0], str):
+            body = json.dumps({
+                "columnKeys": [c for c, _ in field_values],
+                "values": [int(v) for _, v in field_values],
+            }).encode()
+            self._request("POST", f"{_node_url(host)}/index/{index}/field/{field}/import", body)
+            return
 
         by_shard: Dict[int, List] = {}
         for col, val in field_values:

@@ -197,13 +197,15 @@ class Handler:
         shard = req.get("shard", 0)
         if "values" in req:
             self.api.import_values(
-                index, field, shard, req["columnIDs"], req["values"],
+                index, field, shard, req.get("columnIDs"), req["values"],
                 remote=req.get("remote", False),
+                column_keys=req.get("columnKeys"),
             )
         else:
             self.api.import_bits(
-                index, field, shard, req["rowIDs"], req["columnIDs"],
+                index, field, shard, req.get("rowIDs", []), req.get("columnIDs", []),
                 req.get("timestamps"), remote=req.get("remote", False),
+                row_keys=req.get("rowKeys"), column_keys=req.get("columnKeys"),
             )
         return {}
 

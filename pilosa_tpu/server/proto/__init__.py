@@ -155,6 +155,8 @@ def decode_import_request(data: bytes) -> dict:
         "shard": req.Shard,
         "rowIDs": list(req.RowIDs),
         "columnIDs": list(req.ColumnIDs),
+        "rowKeys": list(req.RowKeys) or None,
+        "columnKeys": list(req.ColumnKeys) or None,
         "timestamps": [t or None for t in req.Timestamps] or None,
     }
 

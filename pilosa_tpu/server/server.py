@@ -136,6 +136,7 @@ class Server:
 
     def open(self) -> "Server":
         """Open sequence (reference server.go:311-357)."""
+        self._raise_file_limit()
         self.translate_store.open()
         self._httpd, self._http_thread, actual_port = serve(
             self.handler, self.host, self.port
@@ -294,13 +295,30 @@ class Server:
     def _monitor_cache_flush(self) -> None:
         self.holder.flush_caches()
 
+    @staticmethod
+    def _raise_file_limit() -> None:
+        """Raise RLIMIT_NOFILE to its hard max (reference holder.go:470):
+        one open WAL handle per fragment needs headroom."""
+        try:
+            import resource
+
+            soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+            if soft < hard:
+                resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+        except (ImportError, ValueError, OSError):
+            pass
+
     def _monitor_runtime(self) -> None:
-        """Process gauges (reference server.go:655-697 monitorRuntime)."""
+        """Process gauges (reference server.go:655-697 monitorRuntime +
+        gcnotify GC counting)."""
+        import gc
         import resource
 
         usage = resource.getrusage(resource.RUSAGE_SELF)
         self.stats.gauge("maxRSS", usage.ru_maxrss)
         self.stats.gauge("threads", threading.active_count())
+        counts = gc.get_stats()
+        self.stats.gauge("garbageCollections", sum(s["collections"] for s in counts))
         try:
             self.stats.gauge("openFiles", len(os.listdir("/proc/self/fd")))
         except OSError:

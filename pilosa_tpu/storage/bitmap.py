@@ -417,6 +417,22 @@ class Bitmap:
         f.write(data)
         return len(data)
 
+    def check(self) -> List[str]:
+        """Consistency check (reference roaring.go:745 Bitmap.Check /
+        Container.check): containers sorted, unique, non-empty, in-range.
+        Returns a list of problems; empty means consistent."""
+        problems = []
+        for key, c in self.containers.items():
+            if len(c) == 0:
+                problems.append(f"{key}: empty container present")
+                continue
+            if c.dtype != np.uint16:
+                problems.append(f"{key}: wrong dtype {c.dtype}")
+            diffs = np.diff(c.astype(np.int32))
+            if np.any(diffs <= 0):
+                problems.append(f"{key}: values not strictly ascending")
+        return problems
+
 
 def encode_op(typ: int, value: int) -> bytes:
     body = struct.pack("<BQ", typ, value)

@@ -127,3 +127,61 @@ def test_inspect_and_check(tmp_path, server, capsys):
     bad = tmp_path / "bad"
     bad.write_bytes(b"\x00" * 32)
     assert main(["check", str(bad)]) == 1
+
+
+def test_import_with_keys(tmp_path, server):
+    csv_path = tmp_path / "keys.csv"
+    csv_path.write_text("red,alice\nred,bob\nblue,alice\n")
+    rc = main([
+        "import", "--host", f"localhost:{server.port}",
+        "-i", "impk", "-f", "color", "--create",
+        "--index-keys", "--field-keys", str(csv_path),
+    ])
+    assert rc == 0
+    from pilosa_tpu.server.client import InternalClient
+
+    resp = InternalClient().query(
+        f"localhost:{server.port}", "impk", 'Row(color="red")'
+    )
+    assert sorted(resp["results"][0]["keys"]) == ["alice", "bob"]
+    resp = InternalClient().query(
+        f"localhost:{server.port}", "impk", "TopN(color, n=2)"
+    )
+    assert resp["results"][0][0]["key"] == "red"
+    assert resp["results"][0][0]["count"] == 2
+
+
+def test_import_int_field_with_keys(tmp_path, server):
+    csv_path = tmp_path / "kv.csv"
+    csv_path.write_text("alice,42\nbob,58\n")
+    rc = main([
+        "import", "--host", f"localhost:{server.port}",
+        "-i", "impkv", "-f", "v", "--create", "--index-keys",
+        "--field-type", "int", "--field-min", "0", "--field-max", "100",
+        str(csv_path),
+    ])
+    assert rc == 0
+    from pilosa_tpu.server.client import InternalClient
+
+    resp = InternalClient().query(f"localhost:{server.port}", "impkv", "Sum(field=v)")
+    assert resp["results"][0] == {"value": 100, "count": 2}
+
+
+def test_import_length_mismatch_is_400(server):
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.server.client import InternalClient
+
+    c = InternalClient()
+    c.create_index(f"localhost:{server.port}", "mis", {"keys": True})
+    c.create_field(f"localhost:{server.port}", "mis", "f", {"keys": True})
+    req = urllib.request.Request(
+        f"http://localhost:{server.port}/index/mis/field/f/import",
+        data=json.dumps({"rowKeys": ["x", "y"], "columnKeys": ["a"]}).encode(),
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+    assert "mismatch" in ei.value.read().decode()

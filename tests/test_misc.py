@@ -193,3 +193,42 @@ def test_translate_replication(tmp_path):
     primary.translate_columns_to_uint64("i", ["c"])
     replica.apply_log(primary.read_from(size))
     assert replica.translate_columns_to_uint64("i", ["c"]) == [3]
+
+
+def test_statsd_client_wire_format():
+    import socket
+    import threading as th
+
+    from pilosa_tpu.stats import StatsDClient, new_stats_client
+
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(2)
+    port = sock.getsockname()[1]
+    c = StatsDClient("127.0.0.1", port, tags=["env:test"])
+    c.count("setBit", 3)
+    c.gauge("heap", 42.5)
+    c.with_tags("index:i").timing("query", 1.25)
+    msgs = sorted(sock.recv(1024).decode() for _ in range(3))
+    assert msgs[0] == "pilosa_tpu.heap:42.5|g|#env:test"
+    assert msgs[1] == "pilosa_tpu.query:1.25|ms|#env:test,index:i"
+    assert msgs[2] == "pilosa_tpu.setBit:3|c|#env:test"
+    sock.close()
+    # Factory selection.
+    from pilosa_tpu.stats import InMemoryStatsClient, MultiStatsClient, NopStatsClient
+
+    assert isinstance(new_stats_client("nop"), NopStatsClient)
+    assert isinstance(new_stats_client("inmem"), InMemoryStatsClient)
+    assert isinstance(new_stats_client("statsd", "127.0.0.1:8125"), MultiStatsClient)
+
+
+def test_bitmap_check():
+    import numpy as np
+
+    from pilosa_tpu.storage.bitmap import Bitmap
+
+    b = Bitmap([1, 2, 3, 100000])
+    assert b.check() == []
+    b.containers[99] = np.array([5, 5, 4], dtype=np.uint16)  # corrupt
+    problems = b.check()
+    assert any("ascending" in p for p in problems)
