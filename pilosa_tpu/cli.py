@@ -30,6 +30,12 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--long-query-time", dest="long_query_time", type=float)
     p.add_argument("--anti-entropy-interval", dest="anti_entropy_interval", type=float)
     p.add_argument("--translation-primary-url", dest="translation_primary_url")
+    p.add_argument("--tls-certificate", dest="tls_certificate")
+    p.add_argument("--tls-certificate-key", dest="tls_certificate_key")
+    p.add_argument("--tls-skip-verify", dest="tls_skip_verify",
+                   action="store_const", const=True, default=None)
+    p.add_argument("--handler-allowed-origins", dest="allowed_origins",
+                   type=lambda s: [h.strip() for h in s.split(",") if h.strip()])
 
 
 def _load_config(args) -> Config:
@@ -43,7 +49,9 @@ def cmd_server(args) -> int:
     cfg = _load_config(args)
     server = cfg.build_server(logger=Logger(verbose=cfg.verbose))
     server.open()
-    print(f"pilosa-tpu server listening on http://{server.node.uri}", flush=True)
+    from .server.client import _node_url
+
+    print(f"pilosa-tpu server listening on {_node_url(server.node.uri)}", flush=True)
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))

@@ -43,6 +43,20 @@ class TranslationConfig:
 
 
 @dataclass
+class TLSConfig:
+    # reference server/config.go:67 + TLSConfig struct
+    certificate_path: str = ""
+    certificate_key_path: str = ""
+    skip_verify: bool = False
+
+
+@dataclass
+class HandlerConfig:
+    # reference server/config.go:62-63 (CORS allowed origins)
+    allowed_origins: List[str] = field(default_factory=list)
+
+
+@dataclass
 class Config:
     data_dir: str = "~/.pilosa_tpu"
     bind: str = "localhost:10101"
@@ -52,6 +66,8 @@ class Config:
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     translation: TranslationConfig = field(default_factory=TranslationConfig)
+    tls: TLSConfig = field(default_factory=TLSConfig)
+    handler: HandlerConfig = field(default_factory=HandlerConfig)
 
     # -------------------------------------------------------------- loading
 
@@ -88,6 +104,12 @@ class Config:
         self.metric.diagnostics = m.get("diagnostics", self.metric.diagnostics)
         t = d.get("translation", {})
         self.translation.primary_url = t.get("primary-url", self.translation.primary_url)
+        tls = d.get("tls", {})
+        self.tls.certificate_path = tls.get("certificate", self.tls.certificate_path)
+        self.tls.certificate_key_path = tls.get("key", self.tls.certificate_key_path)
+        self.tls.skip_verify = tls.get("skip-verify", self.tls.skip_verify)
+        h = d.get("handler", {})
+        self.handler.allowed_origins = h.get("allowed-origins", self.handler.allowed_origins)
 
     def _apply_env(self) -> None:
         def env(name, cast=str):
@@ -125,6 +147,17 @@ class Config:
         v = env("TRANSLATION_PRIMARY_URL", str)
         if v is not None:
             self.translation.primary_url = v
+        for attr, name, cast in [
+            ("certificate_path", "TLS_CERTIFICATE", str),
+            ("certificate_key_path", "TLS_CERTIFICATE_KEY", str),
+            ("skip_verify", "TLS_SKIP_VERIFY", bool),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.tls, attr, v)
+        v = env("HANDLER_ALLOWED_ORIGINS", list)
+        if v is not None:
+            self.handler.allowed_origins = v
 
     def _apply_flags(self, flags: Dict[str, Any]) -> None:
         mapping = {
@@ -139,6 +172,10 @@ class Config:
             "long_query_time": ("cluster", "long_query_time"),
             "anti_entropy_interval": ("anti_entropy", "interval"),
             "translation_primary_url": ("translation", "primary_url"),
+            "tls_certificate": ("tls", "certificate_path"),
+            "tls_certificate_key": ("tls", "certificate_key_path"),
+            "tls_skip_verify": ("tls", "skip_verify"),
+            "allowed_origins": ("handler", "allowed_origins"),
         }
         for key, path in mapping.items():
             v = flags.get(key)
@@ -185,6 +222,14 @@ class Config:
             "",
             "[translation]",
             f"primary-url = {fmt(self.translation.primary_url)}",
+            "",
+            "[tls]",
+            f"certificate = {fmt(self.tls.certificate_path)}",
+            f"key = {fmt(self.tls.certificate_key_path)}",
+            f"skip-verify = {fmt(self.tls.skip_verify)}",
+            "",
+            "[handler]",
+            f"allowed-origins = {fmt(self.handler.allowed_origins)}",
         ]
         return "\n".join(lines) + "\n"
 
@@ -193,12 +238,21 @@ class Config:
         from .server.server import Server
         from .stats import new_stats_client
 
-        host, _, port = self.bind.partition(":")
+        bind = self.bind
+        scheme = "http"
+        if "://" in bind:
+            scheme, _, bind = bind.partition("://")
+        host, _, port = bind.partition(":")
         kw = dict(
             stats=new_stats_client(self.metric.service, self.metric.host),
             data_dir=os.path.expanduser(self.data_dir),
             host=host or "localhost",
             port=int(port or 0),
+            scheme=scheme,
+            tls_certificate=self.tls.certificate_path or None,
+            tls_certificate_key=self.tls.certificate_key_path or None,
+            tls_skip_verify=self.tls.skip_verify,
+            allowed_origins=self.handler.allowed_origins,
             cluster_hosts=self.cluster.hosts,
             is_coordinator=self.cluster.coordinator,
             replica_n=self.cluster.replicas,

@@ -27,6 +27,23 @@ class ApiError(PilosaError):
     pass
 
 
+def _by_shard(column_ids, *payloads):
+    """Group an import batch by owning shard.
+
+    Yields (shard, column_ids, payloads) where each payload list is sliced
+    to that shard's positions; a None payload stays None.
+    """
+    groups: Dict[int, List[int]] = {}
+    for i, col in enumerate(column_ids):
+        groups.setdefault(col // SHARD_WIDTH, []).append(i)
+    for sh, idxs in sorted(groups.items()):
+        cols = [column_ids[i] for i in idxs]
+        sliced = tuple(
+            [p[i] for i in idxs] if p is not None else None for p in payloads
+        )
+        yield sh, cols, sliced
+
+
 # Methods valid in any cluster state (api.go apiMethod "common" set).
 _COMMON_METHODS = {
     "status", "info", "schema", "version", "cluster_message",
@@ -195,19 +212,19 @@ class API:
                     raise QueryError("row keys require field 'keys' option")
                 row_ids = store.translate_rows_to_uint64(index, field, list(row_keys))
             # Re-group by shard now that column ids are known.
-            by_shard: Dict[int, List[int]] = {}
-            for i, col in enumerate(column_ids):
-                by_shard.setdefault(col // SHARD_WIDTH, []).append(i)
-            for sh, idxs in sorted(by_shard.items()):
-                self.import_bits(
-                    index, field, sh,
-                    [row_ids[i] for i in idxs],
-                    [column_ids[i] for i in idxs],
-                    [timestamps[i] for i in idxs] if timestamps else None,
-                    remote=remote,
-                )
+            for sh, cols, (rows, ts) in _by_shard(column_ids, row_ids, timestamps):
+                self.import_bits(index, field, sh, rows, cols, ts, remote=remote)
             return
 
+        n = len(column_ids or [])
+        if len(row_ids or []) != n:
+            raise QueryError(
+                f"import row/column length mismatch: {len(row_ids or [])} rows vs {n} columns"
+            )
+        if timestamps is not None and len(timestamps) != n:
+            raise QueryError(
+                f"import timestamps length mismatch: {len(timestamps)} vs {n}"
+            )
         for node in self.cluster.shard_nodes(index, shard):
             if node.id == self.cluster.node.id:
                 ts = None
@@ -235,19 +252,24 @@ class API:
                 )
             if not idx.keys():
                 raise QueryError("column keys require index 'keys' option")
-            column_ids = self.server.translate_store.translate_columns_to_uint64(
-                index, list(column_keys)
-            )
-            by_shard: Dict[int, List[int]] = {}
-            for i, col in enumerate(column_ids):
-                by_shard.setdefault(col // SHARD_WIDTH, []).append(i)
-            for sh, idxs in sorted(by_shard.items()):
-                self.import_values(
-                    index, field, sh,
-                    [column_ids[i] for i in idxs], [values[i] for i in idxs],
-                    remote=remote,
+            store = self.server.translate_store
+            if store.read_only:
+                # Same primary forwarding as key-mode bit imports: key
+                # allocation only happens on the translation primary.
+                self.server.client.import_value_keys_node(
+                    self.server.primary_translate_store_url, index, field,
+                    column_keys, values,
                 )
+                return
+            column_ids = store.translate_columns_to_uint64(index, list(column_keys))
+            for sh, cols, (vals,) in _by_shard(column_ids, values):
+                self.import_values(index, field, sh, cols, vals, remote=remote)
             return
+        if len(column_ids or []) != len(values or []):
+            raise QueryError(
+                f"import columns/values length mismatch: "
+                f"{len(column_ids or [])} vs {len(values or [])}"
+            )
         for node in self.cluster.shard_nodes(index, shard):
             if node.id == self.cluster.node.id:
                 fld.import_value(column_ids, values)

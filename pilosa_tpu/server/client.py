@@ -31,16 +31,26 @@ def _node_url(node) -> str:
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0, skip_verify: bool = False):
         self.timeout = timeout
+        # TLS peer-verification opt-out for self-signed cluster certs
+        # (reference server/server.go:216-218 InsecureSkipVerify).
+        self._ssl_context = None
+        if skip_verify:
+            import ssl
+
+            self._ssl_context = ssl.create_default_context()
+            self._ssl_context.check_hostname = False
+            self._ssl_context.verify_mode = ssl.CERT_NONE
 
     def _request(self, method: str, url: str, body: Optional[bytes] = None,
                  content_type: str = "application/json") -> bytes:
         req = urllib.request.Request(url, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
+        kwargs = {"context": self._ssl_context} if url.startswith("https") else {}
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout, **kwargs) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
@@ -127,6 +137,15 @@ class InternalClient:
             "rowKeys": list(row_keys) if row_keys else None,
             "columnKeys": list(column_keys) if column_keys else None,
             "timestamps": list(timestamps) if timestamps else None,
+        }).encode()
+        self._request("POST", f"{_node_url(node)}/index/{index}/field/{field}/import", body)
+
+    def import_value_keys_node(self, node, index: str, field: str,
+                               column_keys, values) -> None:
+        """Forward a key-mode value import to the translation primary."""
+        body = json.dumps({
+            "columnKeys": list(column_keys),
+            "values": [int(v) for v in values],
         }).encode()
         self._request("POST", f"{_node_url(node)}/index/{index}/field/{field}/import", body)
 

@@ -79,9 +79,13 @@ class Route:
 class Handler:
     """Routes HTTP requests to API methods."""
 
-    def __init__(self, api: API, logger=None):
+    def __init__(self, api: API, logger=None, allowed_origins: Optional[List[str]] = None):
         self.api = api
         self.logger = logger
+        # CORS allowed origins (reference http/handler.go:83-91 wraps the
+        # router in gorilla handlers.CORS when configured; empty = no CORS,
+        # preflight gets 405 per server/handler_test.go:555-567).
+        self.allowed_origins = list(allowed_origins or [])
         self.routes: List[Route] = [
             Route("GET", r"/", self.handle_home),
             Route("GET", r"/index", self.handle_get_indexes),
@@ -145,6 +149,30 @@ class Handler:
         if path == "/index/" or re.match(r"^/index/[^/]+/query$", path):
             return 405, "text/plain", b"method not allowed"
         return 404, "application/json", json.dumps({"error": "not found"}).encode()
+
+    # ---------------------------------------------------------------- CORS
+
+    def cors_origin(self, origin: Optional[str]) -> Optional[str]:
+        """The Access-Control-Allow-Origin value for a request, or None."""
+        if not origin or not self.allowed_origins:
+            return None
+        if "*" in self.allowed_origins:
+            return "*"
+        return origin if origin in self.allowed_origins else None
+
+    def preflight(self, origin: Optional[str]):
+        """Handle an OPTIONS preflight. Returns (status, extra_headers)."""
+        if not self.allowed_origins:
+            return 405, {}
+        headers = {
+            "Access-Control-Allow-Methods": "GET, POST, DELETE, OPTIONS",
+            "Access-Control-Allow-Headers": "Content-Type",
+            "Vary": "Origin",
+        }
+        allow = self.cors_origin(origin)
+        if allow:
+            headers["Access-Control-Allow-Origin"] = allow
+        return 200, headers
 
     # ------------------------------------------------------------- handlers
 
@@ -439,6 +467,13 @@ class _RequestHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
+        if self.handler.allowed_origins:
+            # The ACAO value varies with the request Origin; shared caches
+            # must not serve one origin's response to another.
+            self.send_header("Vary", "Origin")
+            allow = self.handler.cors_origin(self.headers.get("Origin"))
+            if allow:
+                self.send_header("Access-Control-Allow-Origin", allow)
         self.end_headers()
         self.wfile.write(payload)
 
@@ -451,13 +486,31 @@ class _RequestHandler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         self._do("DELETE")
 
+    def do_OPTIONS(self):
+        status, headers = self.handler.preflight(self.headers.get("Origin"))
+        self.send_response(status)
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
     def log_message(self, fmt, *args):  # silence default stderr logging
         pass
 
 
-def serve(handler: Handler, host: str = "localhost", port: int = 0) -> Tuple[ThreadingHTTPServer, threading.Thread, int]:
+def serve(handler: Handler, host: str = "localhost", port: int = 0,
+          ssl_context=None) -> Tuple[ThreadingHTTPServer, threading.Thread, int]:
     cls = type("BoundHandler", (_RequestHandler,), {"handler": handler})
     httpd = ThreadingHTTPServer((host, port), cls)
+    if ssl_context is not None:
+        # https bind (reference server/server.go:367-375 getListener wraps
+        # the listener in tls.Listen when the bind scheme is https).
+        # do_handshake_on_connect=False: the handshake must run in the
+        # per-connection worker thread, not the single accept loop, or one
+        # stalled client blocks every other connection.
+        httpd.socket = ssl_context.wrap_socket(
+            httpd.socket, server_side=True, do_handshake_on_connect=False
+        )
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     return httpd, thread, httpd.server_address[1]

@@ -55,10 +55,27 @@ class Server:
         diagnostics_endpoint: str = "",
         member_monitor_interval: float = 2.0,
         join_addr: Optional[str] = None,
+        allowed_origins: Optional[List[str]] = None,
+        tls_certificate: Optional[str] = None,
+        tls_certificate_key: Optional[str] = None,
+        tls_skip_verify: bool = False,
+        scheme: str = "http",
     ):
         self.data_dir = data_dir
         self.host = host
         self.port = port
+        # TLS (reference server/server.go:203-232: https scheme requires a
+        # certificate + key; SkipVerify relaxes peer verification on the
+        # internal client).
+        self.scheme = scheme
+        self.tls_certificate = tls_certificate
+        self.tls_certificate_key = tls_certificate_key
+        self.tls_skip_verify = tls_skip_verify
+        if scheme == "https":
+            if not tls_certificate:
+                raise ValueError("certificate path is required for TLS sockets")
+            if not tls_certificate_key:
+                raise ValueError("certificate key path is required for TLS sockets")
         self.logger = logger or NopLogger()
         self.stats = stats or InMemoryStatsClient()
         self.long_query_time = long_query_time
@@ -71,7 +88,7 @@ class Server:
         self.join_addr = join_addr
         self.node_id = node_id or self._load_node_id()
         self.node = Node(
-            id=self.node_id, uri=f"{host}:{port}",
+            id=self.node_id, uri=self._uri(host, port),
             is_coordinator=is_coordinator and join_addr is None,
         )
         self.cluster = Cluster(
@@ -88,8 +105,8 @@ class Server:
             os.path.join(data_dir, "keys") if data_dir else None,
             read_only=primary_translate_store_url is not None,
         )
-        self.client = InternalClient()
-        self._probe_client = InternalClient(timeout=2.0)
+        self.client = InternalClient(skip_verify=tls_skip_verify)
+        self._probe_client = InternalClient(timeout=2.0, skip_verify=tls_skip_verify)
         self.executor = Executor(
             self.holder,
             cluster=self.cluster,
@@ -99,7 +116,7 @@ class Server:
             workers=executor_workers,
         )
         self.api = API(self)
-        self.handler = Handler(self.api, logger=self.logger)
+        self.handler = Handler(self.api, logger=self.logger, allowed_origins=allowed_origins)
 
         from ..cluster.topology import Topology
         from ..diagnostics import DiagnosticsCollector
@@ -120,6 +137,19 @@ class Server:
 
     # ------------------------------------------------------------ lifecycle
 
+    def _uri(self, host: str, port: int) -> str:
+        """Node URI; carries the scheme only when non-default (https)."""
+        return f"https://{host}:{port}" if self.scheme == "https" else f"{host}:{port}"
+
+    def _ssl_context(self):
+        if self.scheme != "https":
+            return None
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.tls_certificate, self.tls_certificate_key)
+        return ctx
+
     def _load_node_id(self) -> str:
         """Stable node id persisted in the data dir (reference holder.go:518)."""
         if not self.data_dir:
@@ -139,22 +169,38 @@ class Server:
         self._raise_file_limit()
         self.translate_store.open()
         self._httpd, self._http_thread, actual_port = serve(
-            self.handler, self.host, self.port
+            self.handler, self.host, self.port, ssl_context=self._ssl_context()
         )
         self.port = actual_port
-        self.node.uri = f"{self.host}:{actual_port}"
+        self.node.uri = self._uri(self.host, actual_port)
 
         # Static cluster membership: node list from config. Node identity
         # must agree across peers without gossip, so in static mode the URI
         # is the node id (reference `cluster.disabled` mode behaves the same
         # way, cluster.go:1804+).
         if self._static_hosts:
-            self.node.id = self.node.uri
-            self.node_id = self.node.uri
+            def hostport(u: str) -> str:
+                return u.split("://", 1)[-1]
+
+            def normalize(u: str) -> str:
+                # Entries may be schemeless or http://-prefixed; node ids must
+                # agree across peers, so the canonical form is host:port for
+                # http and scheme://host:port otherwise — an https cluster
+                # still needs peers dialed over https.
+                if u.startswith("http://"):
+                    u = u[len("http://"):]
+                if "://" in u or self.scheme == "http":
+                    return u
+                return f"{self.scheme}://{u}"
+
+            self.node.id = normalize(self.node.uri)
+            self.node.uri = self.node.id
+            self.node_id = self.node.id
             self.cluster.nodes = [self.node]
             for host in self._static_hosts:
-                if host != self.node.uri:
-                    self.cluster.add_node(Node(id=host, uri=host))
+                if hostport(host) != hostport(self.node.uri):
+                    peer = normalize(host)
+                    self.cluster.add_node(Node(id=peer, uri=peer))
             self.cluster.nodes.sort(key=lambda n: n.id)
 
         self.holder.open()

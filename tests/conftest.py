@@ -23,3 +23,22 @@ try:
     jax.config.update("jax_num_cpu_devices", 8)
 except AttributeError:
     pass  # older jax: XLA_FLAGS above covers it
+
+
+import subprocess
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def tls_cert(tmp_path_factory):
+    """Self-signed localhost cert/key pair, generated once per session."""
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = d / "node.crt", d / "node.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    return str(cert), str(key)

@@ -185,3 +185,29 @@ def test_import_length_mismatch_is_400(server):
         urllib.request.urlopen(req)
     assert ei.value.code == 400
     assert "mismatch" in ei.value.read().decode()
+
+
+def test_config_tls_and_cors_sections(tmp_path, monkeypatch):
+    cfg_file = tmp_path / "cfg.toml"
+    cfg_file.write_text(
+        'bind = "https://localhost:4443"\n'
+        '[tls]\ncertificate = "/c.pem"\nkey = "/k.pem"\nskip-verify = true\n'
+        '[handler]\nallowed-origins = ["http://a/", "http://b/"]\n'
+    )
+    cfg = Config.load(str(cfg_file))
+    assert cfg.tls.certificate_path == "/c.pem"
+    assert cfg.tls.certificate_key_path == "/k.pem"
+    assert cfg.tls.skip_verify is True
+    assert cfg.handler.allowed_origins == ["http://a/", "http://b/"]
+    # Round-trips through to_toml.
+    (tmp_path / "dump.toml").write_text(cfg.to_toml())
+    cfg2 = Config.load(str(tmp_path / "dump.toml"))
+    assert cfg2.tls.certificate_path == "/c.pem"
+    assert cfg2.handler.allowed_origins == ["http://a/", "http://b/"]
+    # Env override.
+    monkeypatch.setenv("PILOSA_TPU_HANDLER_ALLOWED_ORIGINS", "http://c/")
+    assert Config.load(str(cfg_file)).handler.allowed_origins == ["http://c/"]
+    # Flags (as parsed by the CLI) beat both.
+    cfg3 = Config.load(str(cfg_file), {"allowed_origins": ["http://d/"],
+                                       "tls_skip_verify": False})
+    assert cfg3.handler.allowed_origins == ["http://d/"]

@@ -243,3 +243,166 @@ def test_long_query_logging(tmp_path):
         assert any("long-query-time" in line for _, line in logger.lines)
     finally:
         s.close()
+
+
+def test_cors_preflight_and_header(tmp_path):
+    """CORS parity (reference server/handler_test.go:555-581): OPTIONS is 405
+    with no allowed origins; with origins configured, preflight is 200 and the
+    Access-Control-Allow-Origin header echoes an allowed origin."""
+    import urllib.request
+
+    s = Server(data_dir=str(tmp_path / "nc"), cache_flush_interval=0)
+    s.open()
+    try:
+        req = urllib.request.Request(
+            f"http://localhost:{s.port}/index/foo/query", method="OPTIONS")
+        req.add_header("Origin", "http://test/")
+        req.add_header("Access-Control-Request-Method", "POST")
+        try:
+            urllib.request.urlopen(req)
+            assert False, "expected 405"
+        except urllib.error.HTTPError as e:
+            assert e.code == 405
+    finally:
+        s.close()
+
+    s = Server(data_dir=str(tmp_path / "c"), cache_flush_interval=0,
+               allowed_origins=["http://test/"])
+    s.open()
+    try:
+        req = urllib.request.Request(
+            f"http://localhost:{s.port}/index/foo/query", method="OPTIONS")
+        req.add_header("Origin", "http://test/")
+        req.add_header("Access-Control-Request-Method", "POST")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+            assert resp.headers["Access-Control-Allow-Origin"] == "http://test/"
+        # Header also present on a normal request from an allowed origin.
+        req = urllib.request.Request(f"http://localhost:{s.port}/schema")
+        req.add_header("Origin", "http://test/")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.headers["Access-Control-Allow-Origin"] == "http://test/"
+        # Disallowed origin: no CORS header.
+        req = urllib.request.Request(f"http://localhost:{s.port}/schema")
+        req.add_header("Origin", "http://evil/")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.headers.get("Access-Control-Allow-Origin") is None
+    finally:
+        s.close()
+
+
+def test_tls_server(tmp_path, tls_cert):
+    """https bind with a self-signed cert (reference server/server.go:203-232);
+    internal client with skip_verify talks to it."""
+    cert, key = tls_cert
+    s = Server(
+        data_dir=str(tmp_path / "tls"), cache_flush_interval=0,
+        scheme="https", tls_certificate=cert, tls_certificate_key=key,
+        tls_skip_verify=True,
+    )
+    s.open()
+    try:
+        assert s.node.uri.startswith("https://")
+        c = InternalClient(skip_verify=True)
+        c.create_index(s.node.uri, "sec")
+        c.create_field(s.node.uri, "sec", "f")
+        c.query(s.node.uri, "sec", "Set(1, f=1)")
+        res = c.query(s.node.uri, "sec", "Count(Row(f=1))")
+        assert res["results"][0] == 1
+    finally:
+        s.close()
+
+
+def test_tls_requires_cert():
+    with pytest.raises(ValueError):
+        Server(scheme="https")
+
+
+def test_tls_static_cluster(tmp_path, tls_cert):
+    """Static https cluster with schemeless host entries: the self-entry
+    still matches (no phantom node) and peers are dialed over https."""
+    cert, key = tls_cert
+    ports = [free_port() for _ in range(2)]
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = []
+    try:
+        for i, port in enumerate(ports):
+            s = Server(
+                data_dir=str(tmp_path / f"node{i}"), port=port,
+                cluster_hosts=hosts, hasher=ModHasher(),
+                cache_flush_interval=0, executor_workers=0,
+                scheme="https", tls_certificate=cert,
+                tls_certificate_key=key, tls_skip_verify=True,
+            )
+            s.open()
+            servers.append(s)
+        for s in servers:
+            assert len(s.cluster.nodes) == 2, [n.uri for n in s.cluster.nodes]
+            assert all(n.uri.startswith("https://") for n in s.cluster.nodes)
+        c = InternalClient(skip_verify=True)
+        c.create_index(servers[0].node.uri, "tc")
+        c.create_field(servers[0].node.uri, "tc", "f")
+        time.sleep(0.1)
+        # Bits in two shards: with ModHasher over 2 nodes they land on
+        # different owners, forcing node-to-node fan-out over https.
+        c.query(servers[0].node.uri, "tc", "Set(1, f=5)")
+        c.query(servers[0].node.uri, "tc", f"Set({SHARD_WIDTH + 2}, f=5)")
+        for s in servers:
+            resp = c.query(s.node.uri, "tc", "Count(Row(f=5))")
+            assert resp["results"][0] == 2
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_id_mode_import_missing_rows_is_400(server, client):
+    """ID-mode import with columnIDs but no rowIDs must 400, not silently
+    import nothing."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    client.create_index(host(server), "idm")
+    client.create_field(host(server), "idm", "f")
+    req = urllib.request.Request(
+        f"http://{host(server)}/index/idm/field/f/import",
+        data=_json.dumps({"columnIDs": [1, 2, 3]}).encode(), method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+    assert "mismatch" in ei.value.read().decode()
+
+
+def test_key_import_forwarding_to_translation_primary(tmp_path):
+    """Key-mode bit AND value imports against a translation replica are
+    forwarded to the primary (reference PrimaryTranslateStore semantics)."""
+    primary = Server(data_dir=str(tmp_path / "pri"), cache_flush_interval=0)
+    primary.open()
+    c = InternalClient()
+    try:
+        c.create_index(host(primary), "ki", {"keys": True})
+        c.create_field(host(primary), "ki", "b", {"keys": True})
+        c.create_field(host(primary), "ki", "v", {"type": "int", "min": 0, "max": 100})
+        replica = Server(
+            data_dir=str(tmp_path / "rep"), cache_flush_interval=0,
+            primary_translate_store_url=f"http://{host(primary)}",
+        )
+        replica.open()
+        try:
+            assert replica.translate_store.read_only
+            # Schema must exist on the replica too (it forwards, but the
+            # field lookup happens first).
+            c.create_index(host(replica), "ki", {"keys": True})
+            c.create_field(host(replica), "ki", "b", {"keys": True})
+            c.create_field(host(replica), "ki", "v", {"type": "int", "min": 0, "max": 100})
+            c.import_bits(host(replica), "ki", "b", [("r1", "alice"), ("r1", "bob")])
+            c.import_values(host(replica), "ki", "v", [("alice", 42), ("bob", 58)])
+            resp = c.query(host(primary), "ki", 'Count(Row(b="r1"))')
+            assert resp["results"][0] == 2
+            resp = c.query(host(primary), "ki", "Sum(field=v)")
+            assert resp["results"][0] == {"value": 100, "count": 2}
+        finally:
+            replica.close()
+    finally:
+        primary.close()
