@@ -249,6 +249,18 @@ class ShardedQueryEngine:
         for comp, _ in comps[1:]:
             if tuple(comp.signature) != sig0:
                 raise QueryError("count_batch requires structurally identical queries")
+
+        # Set-op trees (Row/Intersect/Union/Difference/Xor) are elementwise,
+        # so the whole batch vectorizes: dedupe the batch's leaf rows into one
+        # stacked (U, S, W) tensor and gather each query's leaves with a (Q,)
+        # index per leaf position. One small take+logic+popcount program, one
+        # dispatch, one (Q,) transfer — and because the row choice is an
+        # *input* (not baked into the trace), every batch of the same shape
+        # reuses the compiled program.
+        set_ops = {"row", "Intersect", "Union", "Difference", "Xor"}
+        if all(entry[0] in set_ops for entry in sig0):
+            return self._count_batch_setops(index, comps, shards, len(calls))
+
         sig = ("count_batch", sig0, len(shards), len(calls))
         fn = self._count_fns.get(sig)
         if fn is None:
@@ -267,6 +279,47 @@ class ShardedQueryEngine:
             self._leaf_tensor(index, comp.leaves, shards) for comp, _ in comps
         )
         return np.asarray(fn(leavess))
+
+    def _count_batch_setops(self, index: str, comps, shards: Tuple[int, ...],
+                            q: int) -> np.ndarray:
+        slots: Dict[Leaf, int] = {}
+        for comp, _ in comps:
+            for leaf in comp.leaves:
+                slots.setdefault(leaf, len(slots))
+        n_pos = len(comps[0][0].leaves)
+        idxs = tuple(
+            np.array([slots[comp.leaves[j]] for comp, _ in comps], dtype=np.int32)
+            for j in range(n_pos)
+        )
+        unique = [self._gather_leaf(index, leaf, shards) for leaf in slots]
+        # Pad batch and unique-leaf counts to powers of two so varying batch
+        # sizes hit a handful of compiled programs instead of one each.
+        qp = 1 << (q - 1).bit_length()
+        if qp != q:
+            idxs = tuple(np.concatenate([ix, np.full(qp - q, ix[-1], np.int32)])
+                         for ix in idxs)
+        up = 1 << (len(unique) - 1).bit_length()
+        unique.extend(unique[0] for _ in range(up - len(unique)))
+
+        # sig0 is row-independent for set-op trees (Row entries carry leaf
+        # positions, not row ids), so one compiled program serves any rows.
+        sig = ("count_batch_setops", tuple(comps[0][0].signature),
+               len(shards), qp, up)
+        fn = self._count_fns.get(sig)
+        if fn is None:
+            expr = comps[0][1]
+
+            @jax.jit
+            def fn(unique, idxs):
+                stacked = jnp.stack(unique)  # (U, S, W)
+                leaves = tuple(stacked[ix] for ix in idxs)  # each (Q, S, W)
+                plane = expr(leaves)
+                return jnp.sum(
+                    jax.lax.population_count(plane).astype(jnp.int32), axis=(1, 2)
+                )
+
+            self._count_fns[sig] = fn
+        return np.asarray(fn(tuple(unique), idxs))[:q]
 
     def bitmap(self, index: str, call: Call, shards: Sequence[int]) -> Row:
         """Evaluate a bitmap call over all shards; returns a Row whose

@@ -139,3 +139,31 @@ def test_engine_padding_non_divisible(holder, ex):
     engine = ShardedQueryEngine(holder)
     call = parse("Row(f=1)").calls[0]
     assert engine.count("i", call, list(range(5))) == len(expected[("f", 1)])
+
+
+def test_engine_count_batch_setops(holder, ex):
+    """Vectorized batched counts match single-query counts, across batch
+    sizes that exercise the pow2 padding (Q=1, 3, 5) and leaf dedup."""
+    expected = plant(holder, ex)
+    engine = ShardedQueryEngine(holder)
+    shards = list(range(5))
+    queries = [
+        "Intersect(Row(f=1), Row(g=3))",
+        "Intersect(Row(f=1), Row(f=2))",
+        "Intersect(Row(f=2), Row(g=3))",
+        "Intersect(Row(f=1), Row(g=3))",  # duplicate of the first
+        "Intersect(Row(g=3), Row(f=1))",
+    ]
+    calls = [parse(q).calls[0] for q in queries]
+    singles = [engine.count("i", c, shards) for c in calls]
+    for q in (1, 3, 5):
+        got = engine.count_batch("i", calls[:q], shards)
+        assert got.tolist() == singles[:q], q
+    # Same structure, different rows: reuses the compiled program (cache
+    # keyed on structure, not row ids) and still returns correct counts.
+    n_progs = len(engine._count_fns)
+    more = [parse("Intersect(Row(f=2), Row(f=1))").calls[0]] * 4
+    got = engine.count_batch("i", more + calls[:1], shards)
+    assert len(engine._count_fns) == n_progs
+    want = engine.count("i", more[0], shards)
+    assert got.tolist() == [want] * 4 + singles[:1]
