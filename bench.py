@@ -55,9 +55,24 @@ def bench_device(ex, n_rows, n_shards, iters):
     engine.count_batch("bench", calls, shards)
     ex.execute("bench", "TopN(f, n=5)")
 
+    # Pipelined serving: keep several batches in flight so device compute
+    # and host<->device transfer overlap (a serving loop with concurrent
+    # clients does exactly this).
+    depth = int(os.environ.get("BENCH_PIPELINE", "4"))
+    done = 0
+    inflight = []
     start = time.perf_counter()
-    engine.count_batch("bench", calls, shards)
-    count_qps = iters / (time.perf_counter() - start)
+    while True:
+        inflight.append(engine.count_batch_async("bench", calls, shards))
+        if len(inflight) >= depth:
+            np.asarray(inflight.pop(0))
+            done += iters
+        if done >= 8 * iters and time.perf_counter() - start > 1.0:
+            break
+    for r in inflight:
+        np.asarray(r)
+        done += iters
+    count_qps = done / (time.perf_counter() - start)
 
     start = time.perf_counter()
     topn_iters = max(3, iters // 4)
@@ -84,14 +99,17 @@ def bench_host(holder, n_rows, n_shards, iters):
     for row in range(n_rows):
         cache[row] = [host_row(f, row) for f in frags]
 
-    host_iters = max(3, iters // 3)
+    # Time-bounded loop (≥1.5s) so the baseline is stable run to run.
+    done = 0
     start = time.perf_counter()
-    for i in range(host_iters):
+    while done < 3 or time.perf_counter() - start < 1.5:
+        i = done
         a, b = i % n_rows, (i + 1) % n_rows
         total = 0
         for sa, sb in zip(cache[a], cache[b]):
             total += len(np.intersect1d(sa, sb, assume_unique=True))
-    return host_iters / (time.perf_counter() - start)
+        done += 1
+    return done / (time.perf_counter() - start)
 
 
 def main():

@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import csv
 import json
+import os
 import signal
 import sys
 import time
@@ -16,6 +17,19 @@ from typing import List, Optional
 
 from .config import Config
 from .errors import PilosaError
+
+# Honor JAX_PLATFORMS even when the environment pre-imports jax (the env
+# var is only read at import time, so e.g. `JAX_PLATFORMS=cpu pilosa-tpu
+# server` would otherwise still initialize the default accelerator backend
+# on the first device call). Safe as long as no backend is initialized yet.
+_plat = os.environ.get("JAX_PLATFORMS")
+if _plat:
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", _plat)
+    except Exception:
+        pass
 
 
 def _add_config_flags(p: argparse.ArgumentParser) -> None:

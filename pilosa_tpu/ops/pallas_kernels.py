@@ -152,6 +152,73 @@ def fused_nary_count(tape: tuple, *planes: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(out)
 
 
+# ------------------------------------------- batched gather + expr + count
+
+
+def batched_gather_expr_count(stacked, idxs, expr):
+    """Per-query fused gather+expr+popcount: (Q,) int32.
+
+    `stacked` is the resident (U, S, W) uint32 leaf stack, `idxs` is a tuple
+    of L (Q,) int32 leaf-slot vectors (one per leaf position of the
+    compiled expression), `expr` an elementwise jnp function over L planes
+    (a PQL set-op tree). For query q the kernel computes
+    popcount(expr(stacked[idxs[0][q]], ..., stacked[idxs[L-1][q]])) summed
+    over shards and words.
+
+    The slot vectors are scalar-prefetched so the BlockSpec index maps DMA
+    exactly each query's leaf blocks from HBM — the (Q, S, W) gathered
+    intermediate the XLA fallback materializes
+    (parallel/engine.py:_count_batch_setops) never exists here. Caller is
+    responsible for sharding (single-device stacks only; the multi-device
+    mesh path uses the XLA fallback, whose NamedShardings XLA partitions).
+    """
+    u, s, w = stacked.shape
+    l = len(idxs)
+    q = idxs[0].shape[0]
+    wb = min(BLOCK, w)
+    assert w % wb == 0 and wb % 128 == 0, (w, wb)
+    rows_per_block = wb // 128
+    stacked4 = stacked.reshape(u, s, w // 128, 128)
+    grid = (q, s, w // wb)
+
+    def kernel(*refs):
+        leaf_refs = refs[l:-1]
+        out_ref = refs[-1]
+        si = pl.program_id(1)
+        bi = pl.program_id(2)
+        planes = tuple(r[0, 0] for r in leaf_refs)  # (rows_per_block, 128)
+        pc = jax.lax.population_count(expr(planes)).astype(jnp.int32)
+        if pc.shape[0] % 8:
+            pc = jnp.pad(pc, ((0, 8 - pc.shape[0] % 8), (0, 0)))
+        partial = jnp.sum(pc.reshape(-1, 8, 128), axis=0)
+
+        @pl.when((si == 0) & (bi == 0))
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        out_ref[:] += partial[None]
+
+    def leaf_map(j):
+        return lambda qi, si, bi, *idx_refs: (idx_refs[j][qi], si, bi, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=l,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, rows_per_block, 128), leaf_map(j))
+            for j in range(l)
+        ],
+        out_specs=pl.BlockSpec((1, 8, 128), lambda qi, si, bi, *idx_refs: (qi, 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((q, 8, 128), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+    )(*[ix.astype(jnp.int32) for ix in idxs], *([stacked4] * l))
+    return jnp.sum(out, axis=(1, 2))
+
+
 # ------------------------------------------------------- TopN row counting
 
 

@@ -17,6 +17,7 @@ Everything else falls back to the executor's per-shard path.
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -159,6 +160,17 @@ class ShardedQueryEngine:
         self.mesh = mesh if mesh is not None else default_mesh()
         # (index, leaf, shards) -> (generation fingerprint, sharded device array)
         self._leaf_cache: Dict[Tuple, Tuple[Tuple, jax.Array]] = {}
+        self._leaf_bytes = 0
+        # (index, leaves, shards, U) -> (fingerprint, stacked (U, S, W) array)
+        self._stack_cache: Dict[Tuple, Tuple[Tuple, jax.Array]] = {}
+        self._stack_bytes = 0
+        # Device-cache budgets (bytes, LRU-evicted). The stacked tensors
+        # duplicate the per-leaf planes they're built from, so both caches
+        # need a byte bound, not an entry bound — one TopN candidate list
+        # can be 1000x the size of a 2-leaf count stack.
+        self._leaf_budget = int(os.environ.get("PILOSA_LEAF_CACHE_BYTES", 1 << 29))
+        self._stack_budget = int(os.environ.get("PILOSA_STACK_CACHE_BYTES", 1 << 28))
+        self._stack_jit: Optional[Callable] = None
         self._count_fns: Dict[Tuple, Callable] = {}
         self._bitmap_fns: Dict[Tuple, Callable] = {}
 
@@ -167,6 +179,17 @@ class ShardedQueryEngine:
         return self.mesh.devices.size
 
     # --------------------------------------------------------- leaf tensors
+
+    def _fingerprint(self, index: str, leaf: Leaf, shards: Tuple[int, ...]) -> Tuple:
+        """Per-shard fragment generations for one leaf — the staleness key
+        for every device cache (no device work, just holder lookups)."""
+        return tuple(
+            -1 if f is None else f.generation
+            for f in (
+                self.holder.fragment(index, leaf.field, leaf.view, s)
+                for s in shards
+            )
+        )
 
     def _gather_leaf(self, index: str, leaf: Leaf, shards: Tuple[int, ...]) -> jax.Array:
         """(S_padded, W) uint32, sharded over the mesh's shard axis."""
@@ -178,13 +201,23 @@ class ShardedQueryEngine:
         fingerprint = tuple(-1 if f is None else f.generation for f in frags)
         cached = self._leaf_cache.get(key)
         if cached is not None and cached[0] == fingerprint:
+            self._leaf_cache[key] = self._leaf_cache.pop(key)  # LRU touch
             return cached[1]
         buf = np.zeros((s_padded, WORDS_PER_ROW), dtype=np.uint32)
         for i, frag in enumerate(frags):
             if frag is not None:
                 buf[i] = frag.plane_np(leaf.row)
         arr = jax.device_put(buf, shard_sharding(self.mesh, 2))
+        if cached is not None:
+            self._leaf_bytes -= cached[1].nbytes
+            self._leaf_cache.pop(key, None)  # refresh lands at MRU
+        self._leaf_bytes += arr.nbytes
         self._leaf_cache[key] = (fingerprint, arr)
+        while self._leaf_bytes > self._leaf_budget and len(self._leaf_cache) > 1:
+            old_key = next(iter(self._leaf_cache))
+            if old_key == key:
+                break
+            self._leaf_bytes -= self._leaf_cache.pop(old_key)[1].nbytes
         return arr
 
     def _leaf_tensor(self, index: str, leaves: List[Leaf], shards: Tuple[int, ...]):
@@ -192,6 +225,50 @@ class ShardedQueryEngine:
         jitted query fns so each input keeps its NamedSharding (stacking
         outside jit would re-lay-out the data)."""
         return tuple(self._gather_leaf(index, leaf, shards) for leaf in leaves)
+
+    def _stacked_leaf_tensor(
+        self, index: str, leaves: List[Leaf], shards: Tuple[int, ...],
+        pad_pow2: bool = False,
+    ) -> jax.Array:
+        """One resident (U, S, W) device tensor for a leaf list, rebuilt only
+        when a member fragment's generation changes.
+
+        Serving latency for batched queries is dominated by per-call host
+        work, not device FLOPs: passing one argument per leaf (dozens of
+        arrays) and restacking them inside the program costs far more than
+        the popcounts. Keeping the stack resident shrinks every query
+        dispatch to (stacked tensor, small index vectors). `pad_pow2` pads
+        the leading axis with duplicate rows so nearby leaf-set sizes reuse
+        one compiled program."""
+        fp = tuple(self._fingerprint(index, leaf, shards) for leaf in leaves)
+        n = len(leaves)
+        np2 = (1 << (n - 1).bit_length()) if (pad_pow2 and n) else n
+        key = (index, tuple(leaves), shards, np2)
+        cached = self._stack_cache.get(key)
+        if cached is not None and cached[0] == fp:
+            self._stack_cache[key] = self._stack_cache.pop(key)  # LRU touch
+            return cached[1]
+        # Stale or missing: gather member planes (leaf-cache hits are cheap;
+        # on a fresh stack hit above no gather happens at all).
+        arrs = [self._gather_leaf(index, leaf, shards) for leaf in leaves]
+        arrs = arrs + [arrs[0]] * (np2 - n)
+        if self._stack_jit is None:
+            self._stack_jit = jax.jit(
+                lambda xs: jnp.stack(xs),
+                out_shardings=shard_sharding(self.mesh, 3, axis=1),
+            )
+        stacked = self._stack_jit(tuple(arrs))
+        if cached is not None:
+            self._stack_bytes -= cached[1].nbytes
+            self._stack_cache.pop(key, None)  # refresh lands at MRU
+        self._stack_bytes += stacked.nbytes
+        self._stack_cache[key] = (fp, stacked)
+        while self._stack_bytes > self._stack_budget and len(self._stack_cache) > 1:
+            old_key = next(iter(self._stack_cache))
+            if old_key == key:
+                break
+            self._stack_bytes -= self._stack_cache.pop(old_key)[1].nbytes
+        return stacked
 
     # -------------------------------------------------------------- queries
 
@@ -243,6 +320,15 @@ class ShardedQueryEngine:
         host pays one dispatch + one transfer for Q results. This is the
         throughput-serving path (amortizes host<->device latency that caps
         per-call serving at ~1/RTT)."""
+        return np.asarray(self.count_batch_async(index, calls, shards))[: len(calls)]
+
+    def count_batch_async(self, index: str, calls: Sequence[Call],
+                          shards: Sequence[int]) -> jax.Array:
+        """count_batch without blocking on the result: returns the device
+        array (length ≥ len(calls); first len(calls) entries valid). Lets a
+        serving loop keep several batches in flight so device work and
+        host<->device transfer overlap instead of serializing on each
+        batch's round trip."""
         shards = tuple(shards)
         comps = [self._compile(index, c) for c in calls]
         sig0 = tuple(comps[0][0].signature)
@@ -278,10 +364,11 @@ class ShardedQueryEngine:
         leavess = tuple(
             self._leaf_tensor(index, comp.leaves, shards) for comp, _ in comps
         )
-        return np.asarray(fn(leavess))
+        return fn(leavess)
 
     def _count_batch_setops(self, index: str, comps, shards: Tuple[int, ...],
-                            q: int) -> np.ndarray:
+                            q: int) -> jax.Array:
+        """Returns the unmaterialized (Qp,) device counts, Qp ≥ q."""
         slots: Dict[Leaf, int] = {}
         for comp, _ in comps:
             for leaf in comp.leaves:
@@ -291,15 +378,15 @@ class ShardedQueryEngine:
             np.array([slots[comp.leaves[j]] for comp, _ in comps], dtype=np.int32)
             for j in range(n_pos)
         )
-        unique = [self._gather_leaf(index, leaf, shards) for leaf in slots]
-        # Pad batch and unique-leaf counts to powers of two so varying batch
-        # sizes hit a handful of compiled programs instead of one each.
+        # Pad batch size to a power of two so varying batch sizes hit a
+        # handful of compiled programs instead of one each.
         qp = 1 << (q - 1).bit_length()
         if qp != q:
             idxs = tuple(np.concatenate([ix, np.full(qp - q, ix[-1], np.int32)])
                          for ix in idxs)
-        up = 1 << (len(unique) - 1).bit_length()
-        unique.extend(unique[0] for _ in range(up - len(unique)))
+        stacked = self._stacked_leaf_tensor(index, list(slots), shards,
+                                            pad_pow2=True)
+        up = stacked.shape[0]
 
         # sig0 is row-independent for set-op trees (Row entries carry leaf
         # positions, not row ids), so one compiled program serves any rows.
@@ -308,18 +395,44 @@ class ShardedQueryEngine:
         fn = self._count_fns.get(sig)
         if fn is None:
             expr = comps[0][1]
+            if self._use_gather_kernel():
+                from ..ops import pallas_kernels as pk
 
-            @jax.jit
-            def fn(unique, idxs):
-                stacked = jnp.stack(unique)  # (U, S, W)
-                leaves = tuple(stacked[ix] for ix in idxs)  # each (Q, S, W)
-                plane = expr(leaves)
-                return jnp.sum(
-                    jax.lax.population_count(plane).astype(jnp.int32), axis=(1, 2)
-                )
+                @jax.jit
+                def fn(stacked, idxs):
+                    return pk.batched_gather_expr_count(stacked, idxs, expr)
+            else:
+                # XLA fallback: materializes the (Q, S, W) gathers but
+                # partitions cleanly over a multi-device mesh.
+                @jax.jit
+                def fn(stacked, idxs):
+                    leaves = tuple(stacked[ix] for ix in idxs)  # each (Q, S, W)
+                    plane = expr(leaves)
+                    return jnp.sum(
+                        jax.lax.population_count(plane).astype(jnp.int32),
+                        axis=(1, 2),
+                    )
 
             self._count_fns[sig] = fn
-        return np.asarray(fn(tuple(unique), idxs))[:q]
+        return fn(stacked, idxs)
+
+    def _use_gather_kernel(self) -> bool:
+        """Fused Pallas gather kernel: single-device TPU only (the
+        multi-device path relies on XLA partitioning of the fallback;
+        interpret mode would crawl at real plane widths)."""
+        env = os.environ.get("PILOSA_PALLAS_BATCH")
+        if env is not None:
+            v = env.strip().lower()
+            if v in ("1", "true", "yes", "on"):
+                return True
+            if v in ("", "0", "false", "no", "off"):
+                return False
+            # Unrecognized value: fall through to the default gates.
+        if self.mesh.devices.size != 1:
+            return False
+        from ..ops import pallas_kernels as pk
+
+        return pk._on_tpu() and WORDS_PER_ROW % 128 == 0
 
     def bitmap(self, index: str, call: Call, shards: Sequence[int]) -> Row:
         """Evaluate a bitmap call over all shards; returns a Row whose
@@ -348,7 +461,7 @@ class ShardedQueryEngine:
         """
         shards = tuple(shards)
         leaves = [Leaf(field, VIEW_STANDARD, r) for r in row_ids]
-        rows_tensor = self._leaf_tensor(index, leaves, shards)
+        rows_tensor = self._stacked_leaf_tensor(index, leaves, shards)  # (R, S, W)
         s_real = len(shards)
         if src_call is not None:
             comp, expr = self._compile(index, src_call)
@@ -357,8 +470,7 @@ class ShardedQueryEngine:
             fn = self._count_fns.get(sig)
             if fn is None:
                 @jax.jit
-                def fn(rows, src_lv):
-                    stacked = jnp.stack(rows)  # (R, S, W)
+                def fn(stacked, src_lv):
                     row_counts = jnp.sum(
                         jax.lax.population_count(stacked).astype(jnp.int32), axis=2
                     )
@@ -377,8 +489,7 @@ class ShardedQueryEngine:
         fn = self._count_fns.get(sig)
         if fn is None:
             @jax.jit
-            def fn(rows):
-                stacked = jnp.stack(rows)
+            def fn(stacked):
                 return jnp.sum(
                     jax.lax.population_count(stacked).astype(jnp.int32), axis=2
                 )
@@ -394,7 +505,7 @@ class ShardedQueryEngine:
         one batched program — the distributed TopN inner loop."""
         shards = tuple(shards)
         leaves = [Leaf(field, VIEW_STANDARD, r) for r in row_ids]
-        rows_tensor = self._leaf_tensor(index, leaves, shards)  # (R, S, W)
+        rows_tensor = self._stacked_leaf_tensor(index, leaves, shards)  # (R, S, W)
         if src_call is not None:
             comp, expr = self._compile(index, src_call)
             src_leaves = self._leaf_tensor(index, comp.leaves, shards)
@@ -402,9 +513,8 @@ class ShardedQueryEngine:
             fn = self._count_fns.get(sig)
             if fn is None:
                 @jax.jit
-                def fn(rows, src_lv):
+                def fn(stacked, src_lv):
                     src = expr(src_lv)  # (S, W)
-                    stacked = jnp.stack(rows)
                     masked = jnp.bitwise_and(stacked, src[None, :, :])
                     return jnp.sum(
                         jax.lax.population_count(masked).astype(jnp.int32), axis=(1, 2)
@@ -417,8 +527,7 @@ class ShardedQueryEngine:
         fn = self._count_fns.get(sig)
         if fn is None:
             @jax.jit
-            def fn(rows):
-                stacked = jnp.stack(rows)
+            def fn(stacked):
                 return jnp.sum(
                     jax.lax.population_count(stacked).astype(jnp.int32), axis=(1, 2)
                 )
@@ -441,7 +550,7 @@ class ShardedQueryEngine:
         shards = tuple(shards)
         view = VIEW_BSI_GROUP_PREFIX + field
         leaves = [Leaf(field, view, i) for i in range(bit_depth + 1)]
-        planes = self._leaf_tensor(index, leaves, shards)
+        planes = self._stacked_leaf_tensor(index, leaves, shards)  # (D+1, S, W)
         filter_leaves = None
         fsig = ()
         expr = None
@@ -458,7 +567,7 @@ class ShardedQueryEngine:
             if kind == "sum":
                 @jax.jit
                 def fn(planes, flt):
-                    stacked = jnp.stack(planes)  # (D+1, S, W)
+                    stacked = planes  # (D+1, S, W)
                     if expr is not None:
                         stacked = jnp.bitwise_and(stacked, expr(flt)[None])
                     return jnp.sum(

@@ -64,3 +64,48 @@ def test_topn_filter_counts_multiblock():
     got = np.asarray(pk.topn_filter_counts(rows, filt))
     want = [np_popcount(r & filt) for r in rows]
     assert got.tolist() == want
+
+
+def test_batched_gather_expr_count():
+    # (U, S, W) stack; queries gather leaf pairs and count the intersection.
+    import jax.numpy as jnp
+
+    u, s, w, q = 5, 3, 256, 7
+    stacked = RNG.integers(0, 1 << 32, (u, s, w), dtype=np.uint32)
+    ia = RNG.integers(0, u, q).astype(np.int32)
+    ib = RNG.integers(0, u, q).astype(np.int32)
+
+    def expr(planes):
+        return jnp.bitwise_and(planes[0], planes[1])
+
+    got = np.asarray(
+        pk.batched_gather_expr_count(jnp.asarray(stacked), (ia, ib), expr)
+    )
+    want = np.array(
+        [np_popcount(stacked[ia[i]] & stacked[ib[i]]) for i in range(q)]
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_batched_gather_expr_count_three_leaves():
+    import jax.numpy as jnp
+
+    u, s, w, q = 4, 2, 128, 5
+    stacked = RNG.integers(0, 1 << 32, (u, s, w), dtype=np.uint32)
+    idxs = tuple(RNG.integers(0, u, q).astype(np.int32) for _ in range(3))
+
+    def expr(planes):
+        return jnp.bitwise_or(
+            jnp.bitwise_and(planes[0], planes[1]),
+            jnp.bitwise_and(planes[2], jnp.bitwise_not(planes[0])),
+        )
+
+    got = np.asarray(pk.batched_gather_expr_count(jnp.asarray(stacked), idxs, expr))
+    want = np.array([
+        np_popcount(
+            (stacked[idxs[0][i]] & stacked[idxs[1][i]])
+            | (stacked[idxs[2][i]] & ~stacked[idxs[0][i]])
+        )
+        for i in range(q)
+    ])
+    np.testing.assert_array_equal(got, want)

@@ -167,3 +167,58 @@ def test_engine_count_batch_setops(holder, ex):
     assert len(engine._count_fns) == n_progs
     want = engine.count("i", more[0], shards)
     assert got.tolist() == [want] * 4 + singles[:1]
+
+
+def test_engine_count_batch_async_and_stack_invalidation(holder, ex):
+    """count_batch_async returns valid device results, and a mutation
+    between batches refreshes the resident stacked leaf tensor."""
+    import numpy as np
+
+    expected = plant(holder, ex)
+    engine = ShardedQueryEngine(holder)
+    shards = list(range(5))
+    calls = [
+        parse("Intersect(Row(f=1), Row(g=3))").calls[0],
+        parse("Intersect(Row(f=1), Row(f=2))").calls[0],
+    ]
+    singles = [engine.count("i", c, shards) for c in calls]
+    fut = engine.count_batch_async("i", calls, shards)
+    assert np.asarray(fut)[: len(calls)].tolist() == singles
+
+    # Mutate a leaf that participates in the batch; the cached stack must
+    # be rebuilt (generation fingerprint mismatch), not served stale.
+    frag = holder.fragment("i", "f", "standard", 0)
+    col = 777
+    was_set = frag.bit(1, col)
+    if was_set:
+        frag.clear_bit(1, col)
+        expected[("f", 1)].discard(col)
+    else:
+        frag.set_bit(1, col)
+        expected[("f", 1)].add(col)
+    after = engine.count_batch("i", calls, shards).tolist()
+    want = [
+        len(expected[("f", 1)] & expected[("g", 3)]),
+        len(expected[("f", 1)] & expected[("f", 2)]),
+    ]
+    assert after == want
+
+
+def test_engine_leaf_cache_eviction_under_tiny_budget(holder, ex, monkeypatch):
+    """Leaf-cache eviction mid-gather must not crash or corrupt results
+    (regression: fingerprint was read back through the evicting cache)."""
+    monkeypatch.setenv("PILOSA_LEAF_CACHE_BYTES", "8192")
+    monkeypatch.setenv("PILOSA_STACK_CACHE_BYTES", "8192")
+    expected = plant(holder, ex)
+    engine = ShardedQueryEngine(holder)
+    counts = engine.topn_counts("i", "f", list(range(40)), [0])
+    in_shard0 = lambda cols: sum(1 for c in cols if c < SHARD_WIDTH)
+    assert counts[1] == in_shard0(expected[("f", 1)])
+    assert counts[2] == in_shard0(expected[("f", 2)])
+    # Repeat (stack cache path) and a batched count under the same budget.
+    counts2 = engine.topn_counts("i", "f", list(range(40)), [0])
+    assert counts2.tolist() == counts.tolist()
+    calls = [parse("Intersect(Row(f=1), Row(f=2))").calls[0]] * 3
+    got = engine.count_batch("i", calls, list(range(5)))
+    want = len(expected[("f", 1)] & expected[("f", 2)])
+    assert got.tolist() == [want] * 3
