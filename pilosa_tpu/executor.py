@@ -661,14 +661,16 @@ class Executor:
     def _execute_set_value(self, index: str, c: Call, opt: ExecOptions) -> None:
         col_id, ok = c.uint_arg("col")
         if not ok:
-            raise QueryError("SetValue() col argument required")
+            # Message parity: executor_test.go:451-458.
+            raise QueryError("SetValue() column field 'col' required")
         args = {k: v for k, v in c.args.items() if k != "col"}
         for name, value in args.items():
             fld = self.holder.field(index, name)
             if fld is None:
                 raise FieldNotFoundError(name)
             if not isinstance(value, int) or isinstance(value, bool):
-                raise QueryError("invalid BSI group value type")
+                # pilosa.go:42 ErrInvalidBSIGroupValueType.
+                raise QueryError("invalid bsigroup value type")
             fld.set_value(col_id, value)
         self._forward_to_all(index, c, opt)
 
@@ -706,23 +708,63 @@ class Executor:
     # ---------------------------------------------------------- translation
 
     def _translate_call(self, index: str, idx, c: Call) -> None:
-        """Translate string keys to ids in-place (executor.go:1595-1659)."""
+        """Translate string keys to ids in-place (executor.go:1595-1659).
+
+        Mirrors the reference's key selection exactly: Set/Clear/Row use the
+        positional column arg and the field-named row arg; every other call
+        uses literal 'col'/'row' args with the field taken from a 'field'
+        arg — so e.g. SetValue(col=10, f="x") is NOT key-translated and
+        falls through to the BSI type check (executor_test.go:461-466)."""
         store = self.translate_store
         if store is not None:
-            col = c.args.get("_col")
-            if isinstance(col, str):
-                if not idx.keys():
-                    raise QueryError(f"string 'col' value not allowed unless index 'keys' option enabled: {col!r}")
-                c.args["_col"] = store.translate_columns_to_uint64(index, [col])[0]
-            for key in list(c.args):
-                if key.startswith("_") or key in ("field",):
-                    continue
-                value = c.args[key]
-                fld = idx.field(key)
-                if fld is not None and isinstance(value, str):
-                    if not fld.keys():
-                        raise QueryError(f"string 'row' value not allowed unless field 'keys' option enabled: {value!r}")
-                    c.args[key] = store.translate_rows_to_uint64(index, key, [value])[0]
+            if c.name in ("Set", "Clear", "Row"):
+                col_key = "_col"
+                # Reference ignores FieldArg errors here (fieldName, _ =
+                # c.FieldArg()); a missing field is rejected at execution
+                # time, not during translation.
+                try:
+                    field_name = c.field_arg()
+                except Exception:
+                    field_name = None
+                row_key = field_name
+            else:
+                col_key = "col"
+                field_name = c.args.get("field")
+                row_key = "row"
+
+            col = c.args.get(col_key)
+            if idx.keys():
+                if col is not None and not isinstance(col, str):
+                    raise QueryError(
+                        "column value must be a string when index 'keys' option enabled"
+                    )
+                if isinstance(col, str) and col != "":
+                    # Empty keys are not translated (callArgString != ""
+                    # guard); the later uint-arg check rejects the call.
+                    c.args[col_key] = store.translate_columns_to_uint64(index, [col])[0]
+            elif isinstance(col, str):
+                raise QueryError(
+                    "string 'col' value not allowed unless index 'keys' option enabled"
+                )
+
+            if field_name:
+                fld = idx.field(field_name)
+                if fld is None:
+                    raise FieldNotFoundError(field_name)
+                row = c.args.get(row_key)
+                if fld.keys():
+                    if row is not None and not isinstance(row, str):
+                        raise QueryError(
+                            "row value must be a string when field 'keys' option enabled"
+                        )
+                    if isinstance(row, str) and row != "":
+                        c.args[row_key] = store.translate_rows_to_uint64(
+                            index, field_name, [row]
+                        )[0]
+                elif isinstance(row, str):
+                    raise QueryError(
+                        "string 'row' value not allowed unless field 'keys' option enabled"
+                    )
         for child in c.children:
             self._translate_call(index, idx, child)
 

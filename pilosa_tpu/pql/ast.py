@@ -55,17 +55,21 @@ class Call:
 
     def field_arg(self) -> str:
         """The (single) non-reserved argument key (ast.go Call.FieldArg)."""
+        from ..errors import QueryError
+
         for key in sorted(self.args):
             if key not in RESERVED:
                 return key
-        raise ValueError(f"{self.name}() argument required: field")
+        raise QueryError(f"{self.name}() argument required: field")
 
     def uint_arg(self, key: str):
+        from ..errors import QueryError
+
         v = self.args.get(key)
         if v is None:
             return 0, False
         if isinstance(v, bool) or not isinstance(v, int):
-            raise ValueError(f"argument {key!r} is not an integer: {v!r}")
+            raise QueryError(f"argument {key!r} is not an integer: {v!r}")
         return v, True
 
     def has_condition_arg(self) -> bool:
@@ -96,8 +100,7 @@ class Query:
         return "\n".join(str(c) for c in self.calls)
 
 
-WRITE_CALLS = {"Set", "SetBit", "Clear", "ClearBit", "SetValue",
-               "SetRowAttrs", "SetColumnAttrs"}
+WRITE_CALLS = {"Set", "Clear", "SetValue", "SetRowAttrs", "SetColumnAttrs"}
 
 
 def format_value(v) -> str:

@@ -172,11 +172,9 @@ class Parser:
             self.error("expected call name")
         special = {
             "Set": lambda: self.parse_set(name),
-            "SetBit": lambda: self.parse_set(name),
             "SetRowAttrs": self.parse_set_row_attrs,
             "SetColumnAttrs": self.parse_set_column_attrs,
             "Clear": lambda: self.parse_clear(name),
-            "ClearBit": lambda: self.parse_clear(name),
             "TopN": self.parse_topn,
             "Range": self.parse_range,
         }.get(name)
@@ -190,8 +188,11 @@ class Parser:
             except ParseError:
                 self.pos = save
                 call = self.parse_generic(name)
-                call.name = {"SetBit": "Set", "ClearBit": "Clear"}.get(name, name)
             return call
+        # Old (pre-v1) call names parse as generic calls and are rejected by
+        # the executor with "unknown call: SetBit" — matching the surveyed
+        # reference, which dropped the old PQL syntax
+        # (executor_test.go:379-390 TestExecutor_Execute_OldPQL).
         return self.parse_generic(name)
 
     def open(self):

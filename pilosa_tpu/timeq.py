@@ -39,9 +39,12 @@ def views_by_time(name: str, t: datetime, quantum: str) -> List[str]:
 
 
 def _add_months(t: datetime, n: int) -> datetime:
+    """Go time.AddDate month semantics: out-of-range days normalize forward
+    (Jan 31 + 1 month = Mar 3, or Mar 2 in leap years), they don't clamp."""
     month = t.month - 1 + n
     year = t.year + month // 12
-    return t.replace(year=year, month=month % 12 + 1)
+    first = t.replace(year=year, month=month % 12 + 1, day=1)
+    return first + timedelta(days=t.day - 1)
 
 
 def _next_year_gte(t: datetime, end: datetime) -> bool:

@@ -1,0 +1,189 @@
+"""Case-for-case mirrors of reference executor tests not already covered by
+test_executor.py (model: /root/reference/executor_test.go).
+
+Each test names the reference test it mirrors; bit patterns and expected
+results are kept identical so behavior parity is checkable line by line.
+"""
+
+import pytest
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import IndexOptions
+from pilosa_tpu.errors import PilosaError, QueryError
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.translate import TranslateStore
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+@pytest.fixture
+def ex(holder):
+    return Executor(holder, translate_store=TranslateStore().open(), workers=0)
+
+
+def set_bit(holder, index, field, row, col):
+    idx = holder.create_index_if_not_exists(index)
+    fld = idx.create_field_if_not_exists(field)
+    fld.set_bit(row, col)
+
+
+def columns(res):
+    return res.columns().tolist()
+
+
+def test_old_pql_rejected(holder, ex):
+    """TestExecutor_Execute_OldPQL (executor_test.go:379): the surveyed
+    reference dropped pre-v1 syntax; SetBit must fail as an unknown call."""
+    set_bit(holder, "i", "f", 1, 0)
+    with pytest.raises(PilosaError, match="unknown call: SetBit"):
+        ex.execute("i", "SetBit(f=1, row=11, col=1)")
+
+
+def test_empty_intersect_difference_error_empty_union_ok(holder, ex):
+    """TestExecutor_Execute_Empty_{Intersect,Difference,Union}
+    (executor_test.go:163-237)."""
+    set_bit(holder, "i", "general", 10, 1)
+    with pytest.raises(PilosaError):
+        ex.execute("i", "Intersect()")
+    with pytest.raises(PilosaError):
+        ex.execute("i", "Difference()")
+    res = ex.execute("i", "Union()")[0]
+    assert columns(res) == []
+
+
+def test_xor_exact_columns(holder, ex):
+    """TestExecutor_Execute_Xor (executor_test.go:238)."""
+    for row, col in [(10, 0), (10, SHARD_WIDTH + 1), (10, SHARD_WIDTH + 2),
+                     (11, 2), (11, SHARD_WIDTH + 2)]:
+        set_bit(holder, "i", "general", row, col)
+    res = ex.execute("i", "Xor(Row(general=10), Row(general=11))")[0]
+    assert columns(res) == [0, 2, SHARD_WIDTH + 1]
+
+
+def test_topn_fill(holder, ex):
+    """TestExecutor_Execute_TopN_fill (executor_test.go:594): row 0's count
+    in shard 0 alone doesn't beat row 1; phase 2 must refetch exact counts
+    across shards."""
+    for row, col in [(0, 0), (0, 1), (0, 2), (0, SHARD_WIDTH),
+                     (1, SHARD_WIDTH + 2), (1, SHARD_WIDTH)]:
+        set_bit(holder, "i", "f", row, col)
+    pairs = ex.execute("i", "TopN(f, n=1)")[0]
+    assert [(p.id, p.count) for p in pairs] == [(0, 4)]
+
+
+def test_topn_fill_small(holder, ex):
+    """TestExecutor_Execute_TopN_fill_small (executor_test.go:618): row 0
+    has one bit per shard (5 shards); per-shard candidates are the local
+    leaders, the global winner only emerges from the phase-2 refetch."""
+    bits = [(0, 0), (0, SHARD_WIDTH), (0, 2 * SHARD_WIDTH), (0, 3 * SHARD_WIDTH),
+            (0, 4 * SHARD_WIDTH),
+            (1, 0), (1, 1),
+            (2, SHARD_WIDTH), (2, SHARD_WIDTH + 1),
+            (3, 2 * SHARD_WIDTH), (3, 2 * SHARD_WIDTH + 1),
+            (4, 3 * SHARD_WIDTH), (4, 3 * SHARD_WIDTH + 1)]
+    for row, col in bits:
+        set_bit(holder, "i", "f", row, col)
+    pairs = ex.execute("i", "TopN(f, n=1)")[0]
+    assert [(p.id, p.count) for p in pairs] == [(0, 5)]
+
+
+def test_set_value_ok_and_errors(holder, ex):
+    """TestExecutor_Execute_SetValue (executor_test.go:393-470), including
+    exact error-message parity."""
+    idx = holder.create_index_if_not_exists("i")
+    idx.create_field_if_not_exists("f", FieldOptions(type="int", min=0, max=50))
+    idx.create_field_if_not_exists("xxx")
+    ex.execute("i", "SetValue(col=10, f=25)")
+    ex.execute("i", "SetValue(col=100, f=10)")
+    f = idx.field("f")
+    assert f.value(10) == (25, True)
+    assert f.value(100) == (10, True)
+
+    with pytest.raises(PilosaError, match=r"SetValue\(\) column field 'col' required"):
+        ex.execute("i", "SetValue(invalid_column_name=10, f=100)")
+    with pytest.raises(PilosaError, match=r"SetValue\(\) column field 'col' required"):
+        ex.execute("i", 'SetValue(invalid_column_name="bad_column", f=100)')
+    with pytest.raises(PilosaError, match="invalid bsigroup value type"):
+        ex.execute("i", 'SetValue(col=10, f="hello")')
+
+
+def test_set_column_attrs_excludes_field(holder, ex):
+    """TestExecutor_SetColumnAttrs_ExcludeField (executor_test.go:1265):
+    the field arg named in Set() must not leak into column attrs."""
+    idx = holder.create_index_if_not_exists("i")
+    idx.create_field_if_not_exists("f")
+    ex.execute("i", "Set(10, f=1)")
+    ex.execute("i", "SetColumnAttrs(10, foo='bar')")
+    assert idx.column_attr_store.attrs(10) == {"foo": "bar"}
+    ex.execute("i", "Set(20, f=10)")
+    ex.execute("i", "SetColumnAttrs(20, foo='bar')")
+    assert idx.column_attr_store.attrs(20) == {"foo": "bar"}
+
+
+TIME_CLEAR_CASES = [
+    ("Y", [3, 4, 5, 6]),
+    ("M", [3, 4, 6]),
+    ("D", [3, 4, 5, 6]),
+    ("H", [3, 4, 5, 6, 7]),
+    ("YM", [3, 4, 5, 6]),
+    ("YMD", [3, 4, 5, 6]),
+    ("YMDH", [3, 4, 5, 6, 7]),
+    ("MD", [3, 4, 5, 6]),
+    ("MDH", [3, 4, 5, 6, 7]),
+    ("DH", [3, 4, 5, 6, 7]),
+]
+
+
+@pytest.mark.parametrize("quantum,expected", TIME_CLEAR_CASES)
+def test_time_clear_quantums(holder, ex, quantum, expected):
+    """TestExecutor_Time_Clear_Quantums (executor_test.go:1315): Clear()
+    must remove the column from every quantum view, and Range() results
+    depend on which quantum granularities exist."""
+    index_name = quantum.lower()
+    idx = holder.create_index_if_not_exists(index_name)
+    idx.create_field_if_not_exists(
+        "f", FieldOptions(type="time", time_quantum=quantum)
+    )
+    ex.execute(index_name, """
+        Set(2, f=1, 1999-12-31T00:00)
+        Set(3, f=1, 2000-01-01T00:00)
+        Set(4, f=1, 2000-01-02T00:00)
+        Set(5, f=1, 2000-02-01T00:00)
+        Set(6, f=1, 2001-01-01T00:00)
+        Set(7, f=1, 2002-01-01T02:00)
+        Set(2, f=1, 1999-12-30T00:00)
+        Set(2, f=1, 2002-02-01T00:00)
+        Set(2, f=10, 2001-01-01T00:00)
+    """)
+    ex.execute(index_name, "Clear( 2, f=1)")
+    res = ex.execute(index_name, "Range(f=1, 1999-12-31T00:00, 2002-01-01T03:00)")[0]
+    assert columns(res) == expected, quantum
+
+
+def test_translate_does_not_abort_valid_writes(holder, ex):
+    """Reference translateCall ignores FieldArg errors (executor.go:1600);
+    'Set(1, f=1) Clear(2)' applies the Set, then rejects only the Clear at
+    execution time."""
+    idx = holder.create_index_if_not_exists("i")
+    idx.create_field_if_not_exists("f")
+    with pytest.raises(PilosaError):
+        ex.execute("i", "Set(1, f=1)\nClear(2)")
+    res = ex.execute("i", "Count(Row(f=1))")
+    assert res == [1]
+
+
+def test_empty_key_not_translated(holder, ex):
+    """Empty string keys are skipped by translation (callArgString != ""
+    guard, executor.go:1613) and rejected downstream — no phantom id."""
+    holder.create_index_if_not_exists("k", IndexOptions(keys=True)) \
+        .create_field_if_not_exists("f")
+    with pytest.raises(PilosaError):
+        ex.execute("k", 'Set("", f=1)')
