@@ -73,3 +73,55 @@ class DiagnosticsCollector:
             if self.logger:
                 self.logger.debug("diagnostics flush failed: %s", e)
             return False
+
+    # ------------------------------------------------------- version check
+
+    def check_version(self, version_url: str = "") -> Optional[str]:
+        """Fetch the latest release version and log an upgrade hint if the
+        local build is behind (diagnostics.go:100-146 CheckVersion /
+        compareVersion). Returns the warning string (or None). Fetch
+        failures are swallowed — this is best-effort telemetry."""
+        if not version_url:
+            return None
+        try:
+            with urllib.request.urlopen(version_url, timeout=10) as rsp:
+                latest = json.load(rsp).get("version", "")
+        except (OSError, ValueError) as e:
+            if self.logger:
+                self.logger.debug("version check failed: %s", e)
+            return None
+        if not latest or latest == getattr(self, "_last_version", None):
+            return None
+        self._last_version = latest
+        warning = self.compare_version(latest)
+        if warning and self.logger:
+            self.logger.info("%s", warning)
+        return warning
+
+    def compare_version(self, latest: str) -> Optional[str]:
+        """Major/minor/patch comparison (diagnostics.go:133-146)."""
+        cur = _version_segments(latest)
+        loc = _version_segments(__version__)
+        if loc[0] < cur[0]:
+            return (f"Warning: You are running pilosa-tpu {__version__}. "
+                    f"A newer version ({latest}) is available")
+        if loc[1] < cur[1] and loc[0] == cur[0]:
+            return (f"Warning: You are running pilosa-tpu {__version__}. "
+                    f"The latest minor release is {latest}")
+        if loc[2] < cur[2] and loc[:2] == cur[:2]:
+            return f"There is a new patch release of pilosa-tpu available: {latest}"
+        return None
+
+
+def _version_segments(v: str) -> list:
+    """'v1.2.3-rc1' -> [1, 2, 3] (diagnostics.go versionSegments)."""
+    v = v.lstrip("v").split("-")[0]
+    out = []
+    for seg in v.split("."):
+        try:
+            out.append(int(seg))
+        except ValueError:
+            out.append(0)
+    while len(out) < 3:
+        out.append(0)
+    return out

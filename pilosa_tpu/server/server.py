@@ -215,7 +215,7 @@ class Server:
         if self.primary_translate_store_url:
             self._spawn(self._monitor_translate_replication, 1.0)
         if self.diagnostics.interval > 0:
-            self._spawn(self.diagnostics.flush, self.diagnostics.interval)
+            self._spawn(self._monitor_diagnostics, self.diagnostics.interval)
         if self.member_monitor_interval > 0 and len(self.cluster.nodes) > 1:
             self._spawn(self._monitor_members, self.member_monitor_interval)
         self.topology.save(self.cluster.nodes)
@@ -340,6 +340,15 @@ class Server:
 
     def _monitor_cache_flush(self) -> None:
         self.holder.flush_caches()
+
+    def _monitor_diagnostics(self) -> None:
+        """Periodic telemetry flush + best-effort version check
+        (reference server.go:605-653 monitorDiagnostics)."""
+        self.diagnostics.flush()
+        if self.diagnostics.endpoint:
+            self.diagnostics.check_version(
+                self.diagnostics.endpoint.rstrip("/") + "/version"
+            )
 
     @staticmethod
     def _raise_file_limit() -> None:

@@ -232,3 +232,24 @@ def test_bitmap_check():
     b.containers[99] = np.array([5, 5, 4], dtype=np.uint16)  # corrupt
     problems = b.check()
     assert any("ascending" in p for p in problems)
+
+
+def test_diagnostics_version_compare():
+    """compareVersion parity (diagnostics.go:133-146)."""
+    from pilosa_tpu.diagnostics import DiagnosticsCollector, _version_segments
+    from pilosa_tpu import __version__
+
+    assert _version_segments("v1.2.3-rc1") == [1, 2, 3]
+    assert _version_segments("2.0") == [2, 0, 0]
+    d = DiagnosticsCollector.__new__(DiagnosticsCollector)
+    d.logger = None
+    major = _version_segments(__version__)
+    newer_major = f"v{major[0]+1}.0.0"
+    w = d.compare_version(newer_major)
+    assert w and "newer version" in w
+    assert d.compare_version(__version__) is None
+    newer_patch = f"v{major[0]}.{major[1]}.{major[2]+1}"
+    w = d.compare_version(newer_patch)
+    assert w and "patch release" in w
+    # Unreachable endpoint: swallowed, returns None.
+    assert d.check_version("http://127.0.0.1:1/none") is None
