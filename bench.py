@@ -16,9 +16,38 @@ BENCH_DENSITY (default 0.02), BENCH_ITERS (default 128).
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+
+def _ensure_live_backend(timeout=120):
+    """Probe the default jax backend in a subprocess; if it can't
+    initialize (e.g. the TPU tunnel is down), fall back to CPU so the
+    bench always prints its JSON line instead of hanging forever."""
+    forced = os.environ.get("BENCH_FORCE_PLATFORM")
+    if forced:
+        import jax
+
+        jax.config.update("jax_platforms", forced)
+        return forced
+    try:
+        subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); import jax.numpy as jnp; "
+             "jnp.zeros(8).block_until_ready()"],
+            check=True, timeout=timeout, capture_output=True,
+        )
+        return "default"
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print("bench: default backend unavailable; falling back to CPU",
+              file=sys.stderr)
+        return "cpu"
 
 
 def build(n_shards, n_rows, density):
@@ -114,10 +143,11 @@ def bench_host(holder, n_rows, n_shards, iters):
 
 def main():
     n_shards = int(os.environ.get("BENCH_SHARDS", "8"))
-    n_rows = int(os.environ.get("BENCH_ROWS", "32"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "128"))
     density = float(os.environ.get("BENCH_DENSITY", "0.02"))
     iters = int(os.environ.get("BENCH_ITERS", "128"))
 
+    platform = _ensure_live_backend()
     holder, ex = build(n_shards, n_rows, density)
     count_qps, topn_qps = bench_device(ex, n_rows, n_shards, iters)
     host_qps = bench_host(holder, n_rows, n_shards, iters)
@@ -133,6 +163,7 @@ def main():
             "shards": n_shards,
             "rows": n_rows,
             "density": density,
+            "platform": platform,
         },
     }))
 

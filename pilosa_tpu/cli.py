@@ -42,6 +42,7 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    type=lambda s: [h.strip() for h in s.split(",") if h.strip()])
     p.add_argument("--cluster-replicas", dest="cluster_replicas", type=int)
     p.add_argument("--long-query-time", dest="long_query_time", type=float)
+    p.add_argument("--query-coalesce-window", dest="query_coalesce_window", type=float)
     p.add_argument("--anti-entropy-interval", dest="anti_entropy_interval", type=float)
     p.add_argument("--translation-primary-url", dest="translation_primary_url")
     p.add_argument("--tls-certificate", dest="tls_certificate")

@@ -62,6 +62,10 @@ class Config:
     bind: str = "localhost:10101"
     max_writes_per_request: int = 5000
     verbose: bool = False
+    # TPU-first serving: micro-batch window (seconds) for coalescing
+    # concurrent fast-path Count queries into one device program
+    # (parallel/coalescer.py). 0 disables.
+    query_coalesce_window: float = 0.0
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
@@ -89,6 +93,9 @@ class Config:
             "max-writes-per-request", self.max_writes_per_request
         )
         self.verbose = d.get("verbose", self.verbose)
+        self.query_coalesce_window = d.get(
+            "query-coalesce-window", self.query_coalesce_window
+        )
         c = d.get("cluster", {})
         self.cluster.disabled = c.get("disabled", self.cluster.disabled)
         self.cluster.coordinator = c.get("coordinator", self.cluster.coordinator)
@@ -127,6 +134,7 @@ class Config:
             ("bind", "BIND", str),
             ("max_writes_per_request", "MAX_WRITES_PER_REQUEST", int),
             ("verbose", "VERBOSE", bool),
+            ("query_coalesce_window", "QUERY_COALESCE_WINDOW", float),
         ]:
             v = env(name, cast)
             if v is not None:
@@ -170,6 +178,7 @@ class Config:
             "cluster_coordinator": ("cluster", "coordinator"),
             "cluster_disabled": ("cluster", "disabled"),
             "long_query_time": ("cluster", "long_query_time"),
+            "query_coalesce_window": ("query_coalesce_window",),
             "anti_entropy_interval": ("anti_entropy", "interval"),
             "translation_primary_url": ("translation", "primary_url"),
             "tls_certificate": ("tls", "certificate_path"),
@@ -203,6 +212,7 @@ class Config:
             f"bind = {fmt(self.bind)}",
             f"max-writes-per-request = {self.max_writes_per_request}",
             f"verbose = {fmt(self.verbose)}",
+            f"query-coalesce-window = {self.query_coalesce_window}",
             "",
             "[cluster]",
             f"disabled = {fmt(self.cluster.disabled)}",
@@ -261,6 +271,7 @@ class Config:
             metric_poll_interval=self.metric.poll_interval,
             primary_translate_store_url=self.translation.primary_url or None,
             max_writes_per_request=self.max_writes_per_request,
+            query_coalesce_window=self.query_coalesce_window,
         )
         kw.update(overrides)
         return Server(**kw)

@@ -9,6 +9,7 @@ dispatch, the shard map/reduce (executor.go:1464-1593), two-phase TopN
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field as dc_field
 from datetime import datetime
@@ -78,6 +79,7 @@ class Executor:
         translate_store=None,
         max_writes_per_request: int = MAX_WRITES_PER_REQUEST,
         workers: int = 8,
+        coalesce_window: float = 0.0,
     ):
         from .cluster.node import Cluster
 
@@ -88,6 +90,9 @@ class Executor:
         self.max_writes_per_request = max_writes_per_request
         self._pool = ThreadPoolExecutor(max_workers=workers) if workers > 1 else None
         self._engine = None  # lazy ShardedQueryEngine
+        self.coalesce_window = coalesce_window
+        self._coalescer = None  # lazy QueryCoalescer (when window > 0)
+        self._coalescer_init_lock = threading.Lock()
 
     @property
     def engine(self):
@@ -96,6 +101,28 @@ class Executor:
 
             self._engine = ShardedQueryEngine(self.holder)
         return self._engine
+
+    @property
+    def coalescer(self):
+        if self.coalesce_window <= 0:
+            return None
+        if self._coalescer is None:
+            with self._coalescer_init_lock:
+                if self._coalescer is None:  # double-checked: one instance
+                    from .parallel.coalescer import QueryCoalescer
+
+                    self._coalescer = QueryCoalescer(
+                        self.engine, window=self.coalesce_window
+                    )
+        return self._coalescer
+
+    def close(self) -> None:
+        """Release serving resources (coalescer worker, thread pool)."""
+        if self._coalescer is not None:
+            self._coalescer.close()
+            self._coalescer = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
 
 
     @property
@@ -412,6 +439,9 @@ class Executor:
         if shards and self.engine.supports(target):
             def local_runner(local_shards):
                 if kind == "count":
+                    co = self.coalescer
+                    if co is not None:
+                        return co.count(index, target, local_shards)
                     return self.engine.count(index, target, local_shards)
                 return self.engine.bitmap(index, target, local_shards)
 

@@ -51,6 +51,7 @@ class Server:
         primary_translate_store_url: Optional[str] = None,
         max_writes_per_request: int = 5000,
         executor_workers: int = 8,
+        query_coalesce_window: float = 0.0,
         diagnostics_interval: float = 0.0,
         diagnostics_endpoint: str = "",
         member_monitor_interval: float = 2.0,
@@ -114,6 +115,7 @@ class Server:
             translate_store=self.translate_store,
             max_writes_per_request=max_writes_per_request,
             workers=executor_workers,
+            coalesce_window=query_coalesce_window,
         )
         self.api = API(self)
         self.handler = Handler(self.api, logger=self.logger, allowed_origins=allowed_origins)

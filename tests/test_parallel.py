@@ -159,14 +159,18 @@ def test_engine_count_batch_setops(holder, ex):
     for q in (1, 3, 5):
         got = engine.count_batch("i", calls[:q], shards)
         assert got.tolist() == singles[:q], q
-    # Same structure, different rows: reuses the compiled program (cache
-    # keyed on structure, not row ids) and still returns correct counts.
-    n_progs = len(engine._count_fns)
+    # Same structure, different rows: correct counts, and the second run of
+    # the same batch shape must not compile any new program (cache keyed on
+    # structure + deduped batch size, not row ids). The 4 duplicate queries
+    # are memoized within the batch and fanned back out.
     more = [parse("Intersect(Row(f=2), Row(f=1))").calls[0]] * 4
     got = engine.count_batch("i", more + calls[:1], shards)
-    assert len(engine._count_fns) == n_progs
     want = engine.count("i", more[0], shards)
     assert got.tolist() == [want] * 4 + singles[:1]
+    n_progs = len(engine._count_fns)
+    got2 = engine.count_batch("i", more + calls[:1], shards)
+    assert len(engine._count_fns) == n_progs
+    assert got2.tolist() == got.tolist()
 
 
 def test_engine_count_batch_async_and_stack_invalidation(holder, ex):
@@ -222,3 +226,48 @@ def test_engine_leaf_cache_eviction_under_tiny_budget(holder, ex, monkeypatch):
     got = engine.count_batch("i", calls, list(range(5)))
     want = len(expected[("f", 1)] & expected[("f", 2)])
     assert got.tolist() == [want] * 3
+
+
+def test_query_coalescer_batches_concurrent_counts(holder, ex):
+    """Concurrent fast-path Counts coalesce into one batched device
+    program with per-query results identical to direct execution."""
+    import threading
+
+    from pilosa_tpu.parallel.coalescer import QueryCoalescer
+
+    expected = plant(holder, ex)
+    engine = ShardedQueryEngine(holder)
+    co = QueryCoalescer(engine, window=0.05)
+    shards = list(range(5))
+    queries = [
+        "Intersect(Row(f=1), Row(g=3))",
+        "Intersect(Row(f=1), Row(f=2))",
+        "Intersect(Row(f=2), Row(g=3))",
+        "Intersect(Row(f=1), Row(g=3))",
+    ] * 3
+    calls = [parse(q).calls[0] for q in queries]
+    singles = [engine.count("i", c, shards) for c in calls]
+
+    results = [None] * len(calls)
+    def worker(i):
+        results[i] = co.count("i", calls[i], shards)
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(calls))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    co.close()
+    assert results == singles
+    # At least one multi-query batch actually executed.
+    assert co.batches_executed >= 1 and co.queries_batched >= 2
+
+
+def test_executor_coalesce_window_wiring(holder, ex):
+    """Executor with coalesce_window routes fast-path Count through the
+    coalescer and still returns correct results."""
+    expected = plant(holder, ex)
+    ex2 = Executor(holder, workers=0, coalesce_window=0.001)
+    want = len(expected[("f", 1)] & expected[("g", 3)])
+    assert ex2.execute("i", "Count(Intersect(Row(f=1), Row(g=3)))") == [want]
+    assert ex2.coalescer is not None
+    ex2.coalescer.close()
