@@ -18,12 +18,29 @@ from . import __version__
 from .sysinfo import system_info
 
 
+def _sibling_version_url(endpoint: str) -> str:
+    """The reference's version URL is a *sibling* of the diagnostics endpoint
+    (.../v0/diagnostics vs .../v0/version — diagnostics.go defaultVersionCheckURL),
+    not a child: replace the last *path* segment with 'version'. Only the URL
+    path is rewritten — a pathless endpoint gets '/version' appended."""
+    if not endpoint:
+        return ""
+    from urllib.parse import urlsplit, urlunsplit
+
+    parts = urlsplit(endpoint)
+    path = parts.path.rstrip("/")
+    head, _, _ = path.rpartition("/")
+    return urlunsplit(parts._replace(path=head + "/version"))
+
+
 class DiagnosticsCollector:
-    def __init__(self, server, endpoint: str = "", interval: float = 0.0, logger=None):
+    def __init__(self, server, endpoint: str = "", interval: float = 0.0, logger=None,
+                 version_url: str = ""):
         self.server = server
         self.endpoint = endpoint
         self.interval = interval
         self.logger = logger
+        self.version_url = version_url or _sibling_version_url(endpoint)
         self.start_time = time.time()
         self._extra: Dict[str, object] = {}
         self.last_report: Optional[dict] = None
@@ -81,6 +98,7 @@ class DiagnosticsCollector:
         local build is behind (diagnostics.go:100-146 CheckVersion /
         compareVersion). Returns the warning string (or None). Fetch
         failures are swallowed — this is best-effort telemetry."""
+        version_url = version_url or self.version_url
         if not version_url:
             return None
         try:

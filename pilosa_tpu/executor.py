@@ -759,7 +759,11 @@ class Executor:
                 row_key = field_name
             else:
                 col_key = "col"
-                field_name = c.args.get("field")
+                # callArgString semantics: a non-string `field` arg reads as
+                # "" in the reference, so row translation is skipped and the
+                # call is rejected later — not a FieldNotFoundError here.
+                fv = c.args.get("field")
+                field_name = fv if isinstance(fv, str) else None
                 row_key = "row"
 
             col = c.args.get(col_key)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -173,6 +174,92 @@ class ShardedQueryEngine:
         self._stack_jit: Optional[Callable] = None
         self._count_fns: Dict[Tuple, Callable] = {}
         self._bitmap_fns: Dict[Tuple, Callable] = {}
+        # Compiled-program caches are LRU-bounded by entry count: each entry
+        # pins an XLA executable, and a long-lived server seeing varied query
+        # shapes would otherwise accumulate them without bound.
+        self._fn_budget = int(os.environ.get("PILOSA_FN_CACHE_ENTRIES", 256))
+        self._building: Dict[Tuple, threading.Event] = {}
+        # The server handles requests on ThreadingHTTPServer threads plus the
+        # coalescer worker, so every cache (LRU touch included) mutates under
+        # concurrency. One lock guards dict + byte-counter state; device work
+        # (gather, device_put, jit) happens outside it.
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------ caches
+    #
+    # All device caches (compiled programs, leaf planes, stacked tensors)
+    # are mutated from ThreadingHTTPServer threads plus the coalescer
+    # worker. `self._lock` guards dict + byte-counter state; `_gate` /
+    # `_release` dedupe expensive cold builds (XLA trace/compile, host
+    # gathers, device_put) so N concurrent misses on a key do the work
+    # once instead of N times (compile stampede).
+
+    def _gate(self, key, probe: Callable):
+        """Return probe()'s non-None value, or None once the caller holds
+        the build gate for `key` — the caller then MUST publish a value and
+        `_release(key)`, even on failure. Waiters re-probe when the builder
+        releases; the wait timeout + ownership steal means a died builder
+        costs one 10s stall, never a deadlock or a permanent stall."""
+        while True:
+            val = probe()
+            if val is not None:
+                return val
+            with self._lock:
+                ev = self._building.get(key)
+                if ev is None:
+                    self._building[key] = threading.Event()
+                    return None
+            if not ev.wait(timeout=10.0):
+                with self._lock:
+                    if self._building.get(key) is ev:
+                        self._building[key] = threading.Event()
+                        return None
+
+    def _release(self, key) -> None:
+        with self._lock:
+            ev = self._building.pop(key, None)
+        if ev is not None:
+            ev.set()
+
+    def _fn_probe(self, cache: Dict[Tuple, Callable], sig: Tuple) -> Optional[Callable]:
+        with self._lock:
+            fn = cache.get(sig)
+            if fn is not None:
+                cache[sig] = cache.pop(sig)  # LRU touch
+            return fn
+
+    def _fn_build(self, cache: Dict[Tuple, Callable], sig: Tuple,
+                  build: Callable[[], Callable]) -> Callable:
+        """Get-or-build a compiled program, stampede-gated and LRU-bounded."""
+        fn = self._gate(sig, lambda: self._fn_probe(cache, sig))
+        if fn is not None:
+            return fn
+        try:
+            fn = build()
+            with self._lock:
+                cache[sig] = fn
+                while len(cache) > self._fn_budget:
+                    cache.pop(next(iter(cache)))
+        finally:
+            self._release(sig)
+        return fn
+
+    def _byte_cache_put(self, cache: Dict, key, entry: Tuple, budget: int,
+                        used: int) -> int:
+        """Insert (fingerprint, array) at MRU and evict LRU entries past the
+        byte budget; returns the updated used-bytes counter. Caller holds
+        self._lock."""
+        prev = cache.pop(key, None)
+        if prev is not None:
+            used -= prev[1].nbytes
+        used += entry[1].nbytes
+        cache[key] = entry
+        while used > budget and len(cache) > 1:
+            old_key = next(iter(cache))
+            if old_key == key:
+                break
+            used -= cache.pop(old_key)[1].nbytes
+        return used
 
     @property
     def n_devices(self) -> int:
@@ -199,25 +286,31 @@ class ShardedQueryEngine:
             self.holder.fragment(index, leaf.field, leaf.view, s) for s in shards
         ]
         fingerprint = tuple(-1 if f is None else f.generation for f in frags)
-        cached = self._leaf_cache.get(key)
-        if cached is not None and cached[0] == fingerprint:
-            self._leaf_cache[key] = self._leaf_cache.pop(key)  # LRU touch
-            return cached[1]
-        buf = np.zeros((s_padded, WORDS_PER_ROW), dtype=np.uint32)
-        for i, frag in enumerate(frags):
-            if frag is not None:
-                buf[i] = frag.plane_np(leaf.row)
-        arr = jax.device_put(buf, shard_sharding(self.mesh, 2))
-        if cached is not None:
-            self._leaf_bytes -= cached[1].nbytes
-            self._leaf_cache.pop(key, None)  # refresh lands at MRU
-        self._leaf_bytes += arr.nbytes
-        self._leaf_cache[key] = (fingerprint, arr)
-        while self._leaf_bytes > self._leaf_budget and len(self._leaf_cache) > 1:
-            old_key = next(iter(self._leaf_cache))
-            if old_key == key:
-                break
-            self._leaf_bytes -= self._leaf_cache.pop(old_key)[1].nbytes
+
+        def probe():
+            with self._lock:
+                cached = self._leaf_cache.get(key)
+                if cached is not None and cached[0] == fingerprint:
+                    self._leaf_cache[key] = self._leaf_cache.pop(key)  # LRU touch
+                    return cached[1]
+            return None
+
+        arr = self._gate(("leaf", key), probe)
+        if arr is not None:
+            return arr
+        try:
+            buf = np.zeros((s_padded, WORDS_PER_ROW), dtype=np.uint32)
+            for i, frag in enumerate(frags):
+                if frag is not None:
+                    buf[i] = frag.plane_np(leaf.row)
+            arr = jax.device_put(buf, shard_sharding(self.mesh, 2))
+            with self._lock:
+                self._leaf_bytes = self._byte_cache_put(
+                    self._leaf_cache, key, (fingerprint, arr),
+                    self._leaf_budget, self._leaf_bytes,
+                )
+        finally:
+            self._release(("leaf", key))
         return arr
 
     def _leaf_tensor(self, index: str, leaves: List[Leaf], shards: Tuple[int, ...]):
@@ -244,30 +337,38 @@ class ShardedQueryEngine:
         n = len(leaves)
         np2 = (1 << (n - 1).bit_length()) if (pad_pow2 and n) else n
         key = (index, tuple(leaves), shards, np2)
-        cached = self._stack_cache.get(key)
-        if cached is not None and cached[0] == fp:
-            self._stack_cache[key] = self._stack_cache.pop(key)  # LRU touch
-            return cached[1]
-        # Stale or missing: gather member planes (leaf-cache hits are cheap;
-        # on a fresh stack hit above no gather happens at all).
-        arrs = [self._gather_leaf(index, leaf, shards) for leaf in leaves]
-        arrs = arrs + [arrs[0]] * (np2 - n)
-        if self._stack_jit is None:
-            self._stack_jit = jax.jit(
-                lambda xs: jnp.stack(xs),
-                out_shardings=shard_sharding(self.mesh, 3, axis=1),
-            )
-        stacked = self._stack_jit(tuple(arrs))
-        if cached is not None:
-            self._stack_bytes -= cached[1].nbytes
-            self._stack_cache.pop(key, None)  # refresh lands at MRU
-        self._stack_bytes += stacked.nbytes
-        self._stack_cache[key] = (fp, stacked)
-        while self._stack_bytes > self._stack_budget and len(self._stack_cache) > 1:
-            old_key = next(iter(self._stack_cache))
-            if old_key == key:
-                break
-            self._stack_bytes -= self._stack_cache.pop(old_key)[1].nbytes
+
+        def probe():
+            with self._lock:
+                cached = self._stack_cache.get(key)
+                if cached is not None and cached[0] == fp:
+                    self._stack_cache[key] = self._stack_cache.pop(key)  # LRU touch
+                    return cached[1]
+            return None
+
+        stacked = self._gate(("stack", key), probe)
+        if stacked is not None:
+            return stacked
+        try:
+            # Stale or missing: gather member planes (leaf-cache hits are
+            # cheap; on a fresh stack hit above no gather happens at all).
+            arrs = [self._gather_leaf(index, leaf, shards) for leaf in leaves]
+            arrs = arrs + [arrs[0]] * (np2 - n)
+            with self._lock:
+                if self._stack_jit is None:
+                    self._stack_jit = jax.jit(
+                        lambda xs: jnp.stack(xs),
+                        out_shardings=shard_sharding(self.mesh, 3, axis=1),
+                    )
+                stack_jit = self._stack_jit
+            stacked = stack_jit(tuple(arrs))
+            with self._lock:
+                self._stack_bytes = self._byte_cache_put(
+                    self._stack_cache, key, (fp, stacked),
+                    self._stack_budget, self._stack_bytes,
+                )
+        finally:
+            self._release(("stack", key))
         return stacked
 
     # -------------------------------------------------------------- queries
@@ -282,8 +383,8 @@ class ShardedQueryEngine:
         shards = tuple(shards)
         comp, expr = self._compile(index, call)
         sig = ("count", tuple(comp.signature), len(shards))
-        fn = self._count_fns.get(sig)
-        if fn is None:
+
+        def build():
             @jax.jit
             def fn(leaves):
                 plane = expr(leaves)
@@ -291,7 +392,9 @@ class ShardedQueryEngine:
                 # per-device partial popcounts + an ICI all-reduce.
                 return jnp.sum(jax.lax.population_count(plane).astype(jnp.int32))
 
-            self._count_fns[sig] = fn
+            return fn
+
+        fn = self._fn_build(self._count_fns, sig, build)
         leaves = self._leaf_tensor(index, comp.leaves, shards)
         return int(fn(leaves))
 
@@ -305,14 +408,16 @@ class ShardedQueryEngine:
         shards = tuple(shards)
         comp, expr = comp_expr if comp_expr is not None else self._compile(index, call)
         sig = ("count", tuple(comp.signature), len(shards))
-        fn = self._count_fns.get(sig)
-        if fn is None:
+
+        def build():
             @jax.jit
             def fn(leaves):
                 plane = expr(leaves)
                 return jnp.sum(jax.lax.population_count(plane).astype(jnp.int32))
 
-            self._count_fns[sig] = fn
+            return fn
+
+        fn = self._fn_build(self._count_fns, sig, build)
         return fn(self._leaf_tensor(index, comp.leaves, shards))
 
     def count_batch(self, index: str, calls: Sequence[Call], shards: Sequence[int]) -> np.ndarray:
@@ -353,8 +458,8 @@ class ShardedQueryEngine:
             return self._count_batch_setops(index, comps, shards, len(calls))
 
         sig = ("count_batch", sig0, len(shards), len(calls))
-        fn = self._count_fns.get(sig)
-        if fn is None:
+
+        def build():
             exprs = [e for _, e in comps]
 
             @jax.jit
@@ -365,7 +470,9 @@ class ShardedQueryEngine:
                     outs.append(jnp.sum(jax.lax.population_count(plane).astype(jnp.int32)))
                 return jnp.stack(outs)
 
-            self._count_fns[sig] = fn
+            return fn
+
+        fn = self._fn_build(self._count_fns, sig, build)
         leavess = tuple(
             self._leaf_tensor(index, comp.leaves, shards) for comp, _ in comps
         )
@@ -409,8 +516,7 @@ class ShardedQueryEngine:
         # positions, not row ids), so one compiled program serves any rows.
         sig = ("count_batch_setops", tuple(comps[0][0].signature),
                len(shards), qp, up)
-        fn = self._count_fns.get(sig)
-        if fn is None:
+        def build():
             expr = comps[0][1]
             if self._use_gather_kernel():
                 from ..ops import pallas_kernels as pk
@@ -430,7 +536,9 @@ class ShardedQueryEngine:
                         axis=(1, 2),
                     )
 
-            self._count_fns[sig] = fn
+            return fn
+
+        fn = self._fn_build(self._count_fns, sig, build)
         out = fn(stacked, idxs)
         if inverse is not None:
             out = jnp.take(out, inverse)  # expand memoized results to (Q,)
@@ -460,10 +568,7 @@ class ShardedQueryEngine:
         shards = tuple(shards)
         comp, expr = self._compile(index, call)
         sig = ("bitmap", tuple(comp.signature), len(shards))
-        fn = self._bitmap_fns.get(sig)
-        if fn is None:
-            fn = jax.jit(expr)
-            self._bitmap_fns[sig] = fn
+        fn = self._fn_build(self._bitmap_fns, sig, lambda: jax.jit(expr))
         leaves = self._leaf_tensor(index, comp.leaves, shards)
         planes = fn(leaves)  # (S_padded, W) sharded
         return Row({shard: planes[i] for i, shard in enumerate(shards)})
@@ -487,8 +592,8 @@ class ShardedQueryEngine:
             comp, expr = self._compile(index, src_call)
             src_leaves = self._leaf_tensor(index, comp.leaves, shards)
             sig = ("topn_shard_src", tuple(comp.signature), len(shards), len(row_ids))
-            fn = self._count_fns.get(sig)
-            if fn is None:
+
+            def build():
                 @jax.jit
                 def fn(stacked, src_lv):
                     row_counts = jnp.sum(
@@ -501,20 +606,24 @@ class ShardedQueryEngine:
                     )
                     return row_counts, inter
 
-                self._count_fns[sig] = fn
+                return fn
+
+            fn = self._fn_build(self._count_fns, sig, build)
             row_counts, inter = fn(rows_tensor, src_leaves)
             return np.asarray(row_counts)[:, :s_real], np.asarray(inter)[:, :s_real]
 
         sig = ("topn_shard", len(shards), len(row_ids))
-        fn = self._count_fns.get(sig)
-        if fn is None:
+
+        def build():
             @jax.jit
             def fn(stacked):
                 return jnp.sum(
                     jax.lax.population_count(stacked).astype(jnp.int32), axis=2
                 )
 
-            self._count_fns[sig] = fn
+            return fn
+
+        fn = self._fn_build(self._count_fns, sig, build)
         return np.asarray(fn(rows_tensor))[:, :s_real], None
 
     def topn_counts(
@@ -530,8 +639,8 @@ class ShardedQueryEngine:
             comp, expr = self._compile(index, src_call)
             src_leaves = self._leaf_tensor(index, comp.leaves, shards)
             sig = ("topn_src", tuple(comp.signature), len(shards), len(row_ids))
-            fn = self._count_fns.get(sig)
-            if fn is None:
+
+            def build():
                 @jax.jit
                 def fn(stacked, src_lv):
                     src = expr(src_lv)  # (S, W)
@@ -540,19 +649,23 @@ class ShardedQueryEngine:
                         jax.lax.population_count(masked).astype(jnp.int32), axis=(1, 2)
                     )
 
-                self._count_fns[sig] = fn
+                return fn
+
+            fn = self._fn_build(self._count_fns, sig, build)
             return np.asarray(fn(rows_tensor, src_leaves))
 
         sig = ("topn", len(shards), len(row_ids))
-        fn = self._count_fns.get(sig)
-        if fn is None:
+
+        def build():
             @jax.jit
             def fn(stacked):
                 return jnp.sum(
                     jax.lax.population_count(stacked).astype(jnp.int32), axis=(1, 2)
                 )
 
-            self._count_fns[sig] = fn
+            return fn
+
+        fn = self._fn_build(self._count_fns, sig, build)
         return np.asarray(fn(rows_tensor))
 
     def bsi_val_count(
@@ -579,8 +692,8 @@ class ShardedQueryEngine:
             filter_leaves = self._leaf_tensor(index, comp.leaves, shards)
             fsig = tuple(comp.signature)
         sig = ("bsi", kind, bit_depth, len(shards), fsig)
-        fn = self._count_fns.get(sig)
-        if fn is None:
+
+        def build():
             def total(x):
                 return jnp.sum(jax.lax.population_count(x).astype(jnp.int32))
 
@@ -617,7 +730,9 @@ class ShardedQueryEngine:
                     )
                     return bits, total(consider)
 
-            self._count_fns[sig] = fn
+            return fn
+
+        fn = self._fn_build(self._count_fns, sig, build)
         out = fn(planes, filter_leaves)
         if kind == "sum":
             return np.asarray(out)

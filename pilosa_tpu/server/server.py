@@ -348,9 +348,9 @@ class Server:
         (reference server.go:605-653 monitorDiagnostics)."""
         self.diagnostics.flush()
         if self.diagnostics.endpoint:
-            self.diagnostics.check_version(
-                self.diagnostics.endpoint.rstrip("/") + "/version"
-            )
+            # Version URL is a sibling of the diagnostics endpoint (the
+            # collector derives it; diagnostics.go defaultVersionCheckURL).
+            self.diagnostics.check_version()
 
     @staticmethod
     def _raise_file_limit() -> None:
