@@ -157,21 +157,17 @@ class Fragment:
         cached = self._plane_cache.get(row_id)
         if cached is not None:
             return cached
-        start = row_id * SHARD_WIDTH
-        local = (self.storage.slice_range(start, start + SHARD_WIDTH) - np.uint64(start)).astype(
-            np.uint32
-        )
-        p = jnp.asarray(bp.pack_bits(local))
+        p = jnp.asarray(self.plane_np(row_id))
         self._plane_cache[row_id] = p
         return p
 
     def plane_np(self, row_id: int) -> np.ndarray:
-        """Host numpy bitplane for one row (for batched sharded assembly)."""
+        """Host numpy bitplane for one row (for batched sharded assembly).
+
+        Dense storage containers are copied word-for-word (no value-list
+        round trip); only the container walk is per-row work."""
         start = row_id * SHARD_WIDTH
-        local = (self.storage.slice_range(start, start + SHARD_WIDTH) - np.uint64(start)).astype(
-            np.uint32
-        )
-        return bp.pack_bits(local)
+        return self.storage.range_words(start, start + SHARD_WIDTH).view(np.uint32)
 
     def plane_stack(self, row_ids: Sequence[int]) -> jnp.ndarray:
         return jnp.stack([self.plane(r) for r in row_ids])

@@ -8,18 +8,22 @@ fragments materialize dense uint32 bitplanes in HBM (see ops/bitplane.py);
 this class exists for persistence, imports, WAL replay, and as a numpy
 oracle for kernel tests.
 
-Internally every container is held uniformly as a sorted np.uint16 array
-(no array/bitmap/run polymorphism at rest — that branch-heavy representation
-is exactly what we do NOT want near the compute path). The 3-way form is
-chosen only at serialization time, picking the smallest encoding, which any
-roaring reader (including the reference's) accepts.
+Containers are two-way, mirroring the reference's array/bitmap split
+(roaring/roaring.go:988-1061): a sorted np.uint16 array while sparse
+(≤4096 values, ≤8KiB) and a 1024-word uint64 bitset once dense (8KiB flat,
+O(1) point ops) — the run form exists only on the wire, chosen at
+serialization time when it is the smallest encoding (any roaring reader,
+including the reference's, accepts all three). The dense form is what lets
+imports of billions of bits run at memory bandwidth instead of O(n) numpy
+inserts, and lets row planes be assembled by copying words instead of
+re-packing value lists.
 """
 
 from __future__ import annotations
 
 import io
 import struct
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -27,7 +31,7 @@ MAGIC_NUMBER = 12348
 STORAGE_VERSION = 0
 COOKIE = MAGIC_NUMBER + (STORAGE_VERSION << 16)
 HEADER_BASE_SIZE = 8
-BITMAP_N = (1 << 16) // 64  # words per serialized bitmap container
+BITMAP_N = (1 << 16) // 64  # words per bitset container
 
 CONTAINER_ARRAY = 1
 CONTAINER_BITMAP = 2
@@ -40,6 +44,8 @@ OP_ADD = 0
 OP_REMOVE = 1
 OP_SIZE = 1 + 8 + 4
 
+_WORD_ONE = np.uint64(1)
+
 
 def fnv32a(data: bytes) -> int:
     h = 2166136261
@@ -51,6 +57,288 @@ def fnv32a(data: bytes) -> int:
 
 def _empty() -> np.ndarray:
     return np.empty(0, dtype=np.uint16)
+
+
+def _popcount(words: np.ndarray) -> int:
+    return int(np.bitwise_count(words).sum())
+
+
+def _arr_to_words(arr: np.ndarray) -> np.ndarray:
+    """Sorted uint16 values -> 1024-word uint64 bitset. Bool-scatter +
+    packbits runs at C speed (np.bitwise_or.at is an order of magnitude
+    slower on duplicate-free scatters)."""
+    bools = np.zeros(1 << 16, dtype=bool)
+    if len(arr):
+        bools[arr] = True
+    return np.packbits(bools, bitorder="little").view(np.uint64).copy()
+
+
+def _words_to_arr(words: np.ndarray) -> np.ndarray:
+    """1024-word uint64 bitset -> sorted uint16 values."""
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    return np.flatnonzero(bits).astype(np.uint16)
+
+
+def _in_bits(words: np.ndarray, arr: np.ndarray) -> np.ndarray:
+    """Boolean mask: which of the sorted uint16 `arr` are set in `words`."""
+    idx = arr.astype(np.uint32)
+    return (words[idx >> 6] >> (idx & np.uint32(63)).astype(np.uint64)) & _WORD_ONE != 0
+
+
+class Container:
+    """One 2^16-bit block: sorted uint16 array (sparse) or uint64 bitset
+    (dense). `n` is always the exact cardinality."""
+
+    __slots__ = ("arr", "bits", "n")
+
+    def __init__(self, arr: Optional[np.ndarray] = None,
+                 bits: Optional[np.ndarray] = None, n: Optional[int] = None):
+        self.arr = arr
+        self.bits = bits
+        self.n = (len(arr) if arr is not None else _popcount(bits)) if n is None else n
+
+    # ------------------------------------------------------------ factories
+
+    @classmethod
+    def from_sorted(cls, arr: np.ndarray) -> "Container":
+        """From a sorted unique uint16 array; picks the right form."""
+        if len(arr) > ARRAY_MAX_SIZE:
+            return cls(bits=_arr_to_words(arr), n=len(arr))
+        return cls(arr=np.ascontiguousarray(arr, dtype=np.uint16))
+
+    # --------------------------------------------------------------- views
+
+    def to_array(self) -> np.ndarray:
+        """Sorted uint16 values (materializes from a bitset)."""
+        return self.arr if self.arr is not None else _words_to_arr(self.bits)
+
+    def as_words(self) -> np.ndarray:
+        """1024-word uint64 bitset view (materializes from an array)."""
+        return self.bits if self.bits is not None else _arr_to_words(self.arr)
+
+    # ----------------------------------------------------- form management
+
+    def _maybe_densify(self) -> None:
+        if self.arr is not None and self.n > ARRAY_MAX_SIZE:
+            self.bits = _arr_to_words(self.arr)
+            self.arr = None
+
+    def _maybe_sparsify(self) -> None:
+        # Hysteresis at half the threshold so add/remove churn around the
+        # boundary doesn't convert back and forth (the reference converts
+        # eagerly at the boundary; we keep its serialized form identical).
+        if self.bits is not None and self.n <= ARRAY_MAX_SIZE // 2:
+            self.arr = _words_to_arr(self.bits)
+            self.bits = None
+
+    # ------------------------------------------------------------ point ops
+
+    def add(self, low: int) -> bool:
+        if self.bits is not None:
+            w, b = low >> 6, np.uint64(low & 63)
+            if (self.bits[w] >> b) & _WORD_ONE:
+                return False
+            self.bits[w] |= _WORD_ONE << b
+            self.n += 1
+            return True
+        c = self.arr
+        i = int(np.searchsorted(c, np.uint16(low)))
+        if i < len(c) and c[i] == low:
+            return False
+        self.arr = np.insert(c, i, np.uint16(low))
+        self.n += 1
+        self._maybe_densify()
+        return True
+
+    def remove(self, low: int) -> bool:
+        if self.bits is not None:
+            w, b = low >> 6, np.uint64(low & 63)
+            if not (self.bits[w] >> b) & _WORD_ONE:
+                return False
+            self.bits[w] &= ~(_WORD_ONE << b)
+            self.n -= 1
+            self._maybe_sparsify()
+            return True
+        c = self.arr
+        i = int(np.searchsorted(c, np.uint16(low)))
+        if i >= len(c) or c[i] != low:
+            return False
+        self.arr = np.delete(c, i)
+        self.n -= 1
+        return True
+
+    def contains(self, low: int) -> bool:
+        if self.bits is not None:
+            return bool((self.bits[low >> 6] >> np.uint64(low & 63)) & _WORD_ONE)
+        i = int(np.searchsorted(self.arr, np.uint16(low)))
+        return i < len(self.arr) and self.arr[i] == low
+
+    # ------------------------------------------------------------- bulk ops
+
+    def add_sorted(self, chunk: np.ndarray) -> None:
+        """Union in a sorted unique uint16 chunk."""
+        if self.bits is None and self.n + len(chunk) > ARRAY_MAX_SIZE:
+            self._force_densify()
+        if self.bits is not None:
+            self.bits |= _arr_to_words(chunk)
+            self.n = _popcount(self.bits)
+        else:
+            self.arr = np.union1d(self.arr, chunk)
+            self.n = len(self.arr)
+            self._maybe_densify()
+
+    def remove_sorted(self, chunk: np.ndarray) -> None:
+        if self.bits is not None:
+            self.bits &= ~_arr_to_words(chunk)
+            self.n = _popcount(self.bits)
+            self._maybe_sparsify()
+        else:
+            self.arr = np.setdiff1d(self.arr, chunk, assume_unique=True)
+            self.n = len(self.arr)
+
+    def _force_densify(self) -> None:
+        self.bits = _arr_to_words(self.arr)
+        self.arr = None
+
+    # ---------------------------------------------------------- range reads
+
+    def count_range(self, lo: int, hi: int) -> int:
+        """Set bits in [lo, hi); hi may be 65536."""
+        if lo <= 0 and hi >= 1 << 16:
+            return self.n
+        if self.arr is not None:
+            i = np.searchsorted(self.arr, np.uint16(lo)) if lo > 0 else 0
+            j = np.searchsorted(self.arr, np.uint16(hi)) if hi < (1 << 16) else len(self.arr)
+            return int(j - i)
+        wl, wh = lo >> 6, (hi + 63) >> 6
+        words = self.bits[wl:wh].copy()
+        if lo & 63:
+            words[0] &= ~np.uint64(0) << np.uint64(lo & 63)
+        if hi & 63:
+            words[-1] &= (_WORD_ONE << np.uint64(hi & 63)) - _WORD_ONE
+        return _popcount(words)
+
+    def slice_range(self, lo: int, hi: int) -> np.ndarray:
+        """Sorted uint16 values in [lo, hi)."""
+        arr = self.to_array()
+        if lo <= 0 and hi >= 1 << 16:
+            return arr
+        i = np.searchsorted(arr, np.uint16(lo)) if lo > 0 else 0
+        j = np.searchsorted(arr, np.uint16(hi)) if hi < (1 << 16) else len(arr)
+        return arr[i:j]
+
+    # -------------------------------------------------------------- algebra
+
+    def intersection_count(self, other: "Container") -> int:
+        a, b = self, other
+        if a.bits is not None and b.bits is not None:
+            return _popcount(a.bits & b.bits)
+        if a.bits is None and b.bits is None:
+            from .. import native
+
+            if native.available():
+                return native.intersection_count_u16(a.arr, b.arr)
+            return len(np.intersect1d(a.arr, b.arr, assume_unique=True))
+        arr, bits = (a.arr, b.bits) if a.bits is None else (b.arr, a.bits)
+        return int(np.count_nonzero(_in_bits(bits, arr))) if len(arr) else 0
+
+    def _binop_words(self, other: "Container", op) -> "Container":
+        words = op(self.as_words(), other.as_words())
+        n = _popcount(words)
+        if n <= ARRAY_MAX_SIZE:
+            return Container(arr=_words_to_arr(words), n=n)
+        return Container(bits=words, n=n)
+
+    def union(self, other: "Container") -> "Container":
+        if self.bits is None and other.bits is None:
+            return Container.from_sorted(_np_or_native("union_u16", np.union1d)(self.arr, other.arr))
+        return self._binop_words(other, np.bitwise_or)
+
+    def intersect(self, other: "Container") -> "Container":
+        if self.bits is None and other.bits is None:
+            fn = _np_or_native(
+                "intersect_u16", lambda a, b: np.intersect1d(a, b, assume_unique=True)
+            )
+            return Container.from_sorted(fn(self.arr, other.arr))
+        if self.bits is None or other.bits is None:
+            arr, bits = (self.arr, other.bits) if self.bits is None else (other.arr, self.bits)
+            return Container.from_sorted(arr[_in_bits(bits, arr)] if len(arr) else _empty())
+        return self._binop_words(other, np.bitwise_and)
+
+    def difference(self, other: "Container") -> "Container":
+        if self.bits is None:
+            if other.bits is None:
+                fn = _np_or_native(
+                    "difference_u16", lambda a, b: np.setdiff1d(a, b, assume_unique=True)
+                )
+                return Container.from_sorted(fn(self.arr, other.arr))
+            return Container.from_sorted(
+                self.arr[~_in_bits(other.bits, self.arr)] if len(self.arr) else _empty()
+            )
+        return self._binop_words(other, lambda a, b: a & ~b)
+
+    def xor(self, other: "Container") -> "Container":
+        if self.bits is None and other.bits is None:
+            return Container.from_sorted(_np_or_native("xor_u16", np.setxor1d)(self.arr, other.arr))
+        return self._binop_words(other, np.bitwise_xor)
+
+    # ------------------------------------------------------------- plumbing
+
+    def copy(self) -> "Container":
+        if self.bits is not None:
+            return Container(bits=self.bits.copy(), n=self.n)
+        return Container(arr=self.arr.copy(), n=self.n)
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Container):
+            return NotImplemented
+        if self.n != other.n:
+            return False
+        if self.bits is not None and other.bits is not None:
+            return bool(np.array_equal(self.bits, other.bits))
+        return bool(np.array_equal(self.to_array(), other.to_array()))
+
+    def __hash__(self):  # pragma: no cover - containers are not hashable keys
+        raise TypeError("Container is unhashable")
+
+    def check(self, key) -> List[str]:
+        problems = []
+        if self.bits is not None:
+            if len(self.bits) != BITMAP_N:
+                problems.append(f"{key}: bitset has {len(self.bits)} words")
+            elif self.n != _popcount(self.bits):
+                problems.append(f"{key}: cardinality {self.n} != popcount")
+            elif self.n == 0:
+                problems.append(f"{key}: empty container present")
+            return problems
+        c = self.arr
+        if len(c) == 0:
+            problems.append(f"{key}: empty container present")
+            return problems
+        if c.dtype != np.uint16:
+            problems.append(f"{key}: wrong dtype {c.dtype}")
+        if self.n != len(c):
+            problems.append(f"{key}: cardinality {self.n} != len {len(c)}")
+        diffs = np.diff(c.astype(np.int32))
+        if np.any(diffs <= 0):
+            problems.append(f"{key}: values not strictly ascending")
+        return problems
+
+
+def _np_or_native(native_name: str, fallback):
+    from .. import native
+
+    fn = getattr(native, native_name, None) if native.available() else None
+    return fn if fn is not None else fallback
+
+
+def _as_container(c) -> Container:
+    """Accept raw sorted uint16 ndarrays wherever a Container is expected
+    (older callers and tests hand those in directly)."""
+    return c if isinstance(c, Container) else Container(arr=np.asarray(c, dtype=np.uint16))
 
 
 # Pluggable container-store backend (the reference's Containers interface,
@@ -70,95 +358,138 @@ def get_container_factory():
     return _CONTAINER_FACTORY
 
 
-class Bitmap:
-    """Sorted-container bitmap over uint64 values."""
+from collections.abc import MutableMapping
 
-    __slots__ = ("containers", "op_n")
+
+class _ContainerMap(MutableMapping):
+    """Thin wrapper around the container store that notifies the owning
+    Bitmap when the *key set* changes, keeping the sorted-key cache honest
+    even for callers that assign `bm.containers[key] = ...` directly."""
+
+    __slots__ = ("store", "_on_keys_changed")
+
+    def __init__(self, store, on_keys_changed):
+        self.store = store
+        self._on_keys_changed = on_keys_changed
+
+    def __getitem__(self, key):
+        return self.store[key]
+
+    def __setitem__(self, key, value):
+        if key not in self.store:
+            self._on_keys_changed()
+        self.store[key] = value
+
+    def __delitem__(self, key):
+        del self.store[key]
+        self._on_keys_changed()
+
+    def __iter__(self):
+        return iter(self.store)
+
+    def __len__(self):
+        return len(self.store)
+
+
+class Bitmap:
+    """Two-form-container bitmap over uint64 values."""
+
+    __slots__ = ("containers", "op_n", "_skeys")
 
     def __init__(self, values=None):
-        # key (value >> 16) -> sorted unique np.uint16 array of low bits
-        self.containers = _CONTAINER_FACTORY()
+        # key (value >> 16) -> Container of low 16 bits
+        self.containers = _ContainerMap(_CONTAINER_FACTORY(), self._inval_keys)
         self.op_n = 0
+        self._skeys: Optional[np.ndarray] = None  # sorted key cache
         if values is not None:
             self.add_many(np.asarray(values, dtype=np.uint64))
+
+    # ------------------------------------------------------- key management
+
+    def _inval_keys(self) -> None:
+        self._skeys = None
+
+    def _put(self, key: int, c: Container) -> None:
+        self.containers[key] = c
+
+    def _drop(self, key: int) -> None:
+        self.containers.pop(key, None)
+
+    def _sorted_keys(self) -> np.ndarray:
+        if self._skeys is None:
+            self._skeys = np.array(sorted(self.containers), dtype=np.int64)
+        return self._skeys
+
+    def _live(self, key) -> Optional[Container]:
+        """Container for key, upgraded in place if stored as a raw ndarray
+        (legacy callers/tests) so mutations are not lost."""
+        c = self.containers.get(key)
+        if c is None or isinstance(c, Container):
+            return c
+        c = _as_container(c)
+        self.containers[key] = c
+        return c
 
     # ------------------------------------------------------------------ basic
 
     def add(self, value: int) -> bool:
-        key, low = value >> 16, np.uint16(value & 0xFFFF)
-        c = self.containers.get(key)
+        key, low = value >> 16, int(value) & 0xFFFF
+        c = self._live(key)
         if c is None:
-            self.containers[key] = np.array([low], dtype=np.uint16)
+            self._put(key, Container(arr=np.array([low], dtype=np.uint16)))
             return True
-        i = int(np.searchsorted(c, low))
-        if i < len(c) and c[i] == low:
-            return False
-        self.containers[key] = np.insert(c, i, low)
-        return True
+        return c.add(low)
 
     def remove(self, value: int) -> bool:
-        key, low = value >> 16, np.uint16(value & 0xFFFF)
-        c = self.containers.get(key)
+        key, low = value >> 16, int(value) & 0xFFFF
+        c = self._live(key)
         if c is None:
             return False
-        i = int(np.searchsorted(c, low))
-        if i >= len(c) or c[i] != low:
+        if not c.remove(low):
             return False
-        c = np.delete(c, i)
-        if len(c) == 0:
-            del self.containers[key]
-        else:
-            self.containers[key] = c
+        if c.n == 0:
+            self._drop(key)
         return True
 
     def contains(self, value: int) -> bool:
-        key, low = value >> 16, np.uint16(value & 0xFFFF)
+        key, low = value >> 16, int(value) & 0xFFFF
         c = self.containers.get(key)
-        if c is None:
-            return False
-        i = int(np.searchsorted(c, low))
-        return i < len(c) and c[i] == low
+        return c is not None and _as_container(c).contains(low)
+
+    def _chunked(self, values: np.ndarray):
+        """Yield (key, sorted unique uint16 chunk) per container key."""
+        values = np.unique(np.asarray(values, dtype=np.uint64))
+        keys = values >> np.uint64(16)
+        lows = (values & np.uint64(0xFFFF)).astype(np.uint16)
+        boundaries = np.flatnonzero(np.diff(keys)) + 1
+        starts = np.concatenate(([0], boundaries))
+        ends = np.concatenate((boundaries, [len(values)]))
+        for s, e in zip(starts, ends):
+            yield int(keys[s]), lows[s:e]
 
     def add_many(self, values: np.ndarray) -> None:
         if len(values) == 0:
             return
-        values = np.unique(np.asarray(values, dtype=np.uint64))
-        keys = values >> np.uint64(16)
-        lows = (values & np.uint64(0xFFFF)).astype(np.uint16)
-        boundaries = np.flatnonzero(np.diff(keys)) + 1
-        starts = np.concatenate(([0], boundaries))
-        ends = np.concatenate((boundaries, [len(values)]))
-        for s, e in zip(starts, ends):
-            key = int(keys[s])
-            chunk = lows[s:e]
-            c = self.containers.get(key)
+        for key, chunk in self._chunked(values):
+            c = self._live(key)
             if c is None:
-                self.containers[key] = chunk.copy()
+                self._put(key, Container.from_sorted(chunk.copy()))
             else:
-                self.containers[key] = np.union1d(c, chunk)
+                c.add_sorted(chunk)
 
     def remove_many(self, values: np.ndarray) -> None:
         if len(values) == 0:
             return
-        values = np.unique(np.asarray(values, dtype=np.uint64))
-        keys = values >> np.uint64(16)
-        lows = (values & np.uint64(0xFFFF)).astype(np.uint16)
-        boundaries = np.flatnonzero(np.diff(keys)) + 1
-        starts = np.concatenate(([0], boundaries))
-        ends = np.concatenate((boundaries, [len(values)]))
-        for s, e in zip(starts, ends):
-            key = int(keys[s])
-            c = self.containers.get(key)
+        for key, chunk in self._chunked(values):
+            c = self._live(key)
             if c is None:
                 continue
-            c = np.setdiff1d(c, lows[s:e], assume_unique=True)
-            if len(c) == 0:
-                self.containers.pop(key, None)
-            else:
-                self.containers[key] = c
+            c.remove_sorted(chunk)
+            if c.n == 0:
+                self._drop(key)
 
     def count(self) -> int:
-        return sum(len(c) for c in self.containers.values())
+        return sum(_as_container(c).n for c in self.containers.values())
 
     def any(self) -> bool:
         return bool(self.containers)
@@ -167,19 +498,26 @@ class Bitmap:
         if not self.containers:
             return 0
         key = max(self.containers)
-        return (key << 16) | int(self.containers[key][-1])
+        return (key << 16) | int(_as_container(self.containers[key]).to_array()[-1])
+
+    def _keys_in(self, skey: int, ekey: int) -> np.ndarray:
+        """Container keys in [skey, ekey], ascending — O(log C + hits)."""
+        keys = self._sorted_keys()
+        lo = np.searchsorted(keys, skey)
+        hi = np.searchsorted(keys, ekey, side="right")
+        return keys[lo:hi]
 
     def count_range(self, start: int, end: int) -> int:
         """Number of set bits in [start, end)."""
+        if end <= start:
+            return 0
         n = 0
-        skey, ekey = start >> 16, end >> 16
-        for key in self.containers:
-            if key < skey or key > ekey:
-                continue
-            c = self.containers[key]
-            lo = np.searchsorted(c, np.uint16(start & 0xFFFF)) if key == skey else 0
-            hi = np.searchsorted(c, np.uint16(end & 0xFFFF)) if key == ekey else len(c)
-            n += int(hi - lo)
+        skey, ekey = start >> 16, (end - 1) >> 16
+        for key in self._keys_in(skey, ekey):
+            c = _as_container(self.containers[int(key)])
+            lo = (start & 0xFFFF) if key == skey else 0
+            hi = ((end - 1) & 0xFFFF) + 1 if key == ekey else 1 << 16
+            n += c.count_range(lo, hi)
         return n
 
     def slice(self) -> np.ndarray:
@@ -187,17 +525,45 @@ class Bitmap:
         if not self.containers:
             return np.empty(0, dtype=np.uint64)
         parts = []
-        for key in sorted(self.containers):
-            c = self.containers[key]
-            parts.append((np.uint64(key) << np.uint64(16)) | c.astype(np.uint64))
+        for key in self._sorted_keys():
+            c = _as_container(self.containers[int(key)])
+            parts.append(
+                (np.uint64(key) << np.uint64(16)) | c.to_array().astype(np.uint64)
+            )
         return np.concatenate(parts)
 
     def slice_range(self, start: int, end: int) -> np.ndarray:
-        """Set values in [start, end), ascending."""
-        vals = self.slice()
-        lo = np.searchsorted(vals, np.uint64(start))
-        hi = np.searchsorted(vals, np.uint64(end))
-        return vals[lo:hi]
+        """Set values in [start, end), ascending. Walks only the containers
+        overlapping the range (the hot path behind per-row extraction)."""
+        if end <= start:
+            return np.empty(0, dtype=np.uint64)
+        skey, ekey = start >> 16, (end - 1) >> 16
+        parts = []
+        for key in self._keys_in(skey, ekey):
+            c = _as_container(self.containers[int(key)])
+            lo = (start & 0xFFFF) if key == skey else 0
+            hi = ((end - 1) & 0xFFFF) + 1 if key == ekey else 1 << 16
+            vals = c.slice_range(lo, hi)
+            if len(vals):
+                parts.append((np.uint64(key) << np.uint64(16)) | vals.astype(np.uint64))
+        if not parts:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(parts)
+
+    def range_words(self, start: int, end: int) -> np.ndarray:
+        """Bits [start, end) as a dense little-endian uint64 word array
+        ((end-start)//64 words). start/end must be container-aligned. Dense
+        containers are copied wholesale; this is how fragments assemble row
+        bitplanes without materializing value lists."""
+        if start & 0xFFFF or end & 0xFFFF:
+            raise ValueError("range_words arguments must be container-aligned")
+        skey, ekey = start >> 16, end >> 16
+        out = np.zeros((end - start) // 64, dtype=np.uint64)
+        for key in self._keys_in(skey, ekey - 1):
+            c = _as_container(self.containers[int(key)])
+            off = (int(key) - skey) * BITMAP_N
+            out[off : off + BITMAP_N] = c.as_words()
+        return out
 
     def __iter__(self) -> Iterator[int]:
         for v in self.slice():
@@ -209,7 +575,8 @@ class Bitmap:
         if set(self.containers) != set(other.containers):
             return False
         return all(
-            np.array_equal(c, other.containers[k]) for k, c in self.containers.items()
+            _as_container(c) == _as_container(other.containers[k])
+            for k, c in self.containers.items()
         )
 
     def __len__(self) -> int:
@@ -218,53 +585,42 @@ class Bitmap:
     def clone(self) -> "Bitmap":
         b = Bitmap()
         for k, c in self.containers.items():
-            b.containers[k] = c.copy()
+            b.containers[k] = _as_container(c).copy()
         return b
 
     # ------------------------------------------------------ set algebra (oracle)
 
-    def _binop(self, other: "Bitmap", fn, native_name=None) -> "Bitmap":
-        from .. import native
-
-        nat = getattr(native, native_name) if native_name and native.available() else None
+    def _binop(self, other: "Bitmap", method: str) -> "Bitmap":
         out = Bitmap()
         for key in set(self.containers) | set(other.containers):
-            a = self.containers.get(key, _empty())
-            b = other.containers.get(key, _empty())
-            c = nat(a, b) if nat is not None else fn(a, b)
-            if len(c):
-                out.containers[key] = c.astype(np.uint16)
+            a = self.containers.get(key)
+            b = other.containers.get(key)
+            a = _as_container(a) if a is not None else Container(arr=_empty())
+            b = _as_container(b) if b is not None else Container(arr=_empty())
+            c = getattr(a, method)(b)
+            if c.n:
+                out.containers[key] = c
         return out
 
     def union(self, other: "Bitmap") -> "Bitmap":
-        return self._binop(other, np.union1d, "union_u16")
+        return self._binop(other, "union")
 
     def intersect(self, other: "Bitmap") -> "Bitmap":
-        return self._binop(
-            other, lambda a, b: np.intersect1d(a, b, assume_unique=True), "intersect_u16"
-        )
+        return self._binop(other, "intersect")
 
     def difference(self, other: "Bitmap") -> "Bitmap":
-        return self._binop(
-            other, lambda a, b: np.setdiff1d(a, b, assume_unique=True), "difference_u16"
-        )
+        return self._binop(other, "difference")
 
     def xor(self, other: "Bitmap") -> "Bitmap":
-        return self._binop(other, np.setxor1d, "xor_u16")
+        return self._binop(other, "xor")
 
     def intersection_count(self, other: "Bitmap") -> int:
-        from .. import native
-
-        use_native = native.available()
         n = 0
         for key, a in self.containers.items():
             b = other.containers.get(key)
             if b is None:
                 continue
-            if use_native:
-                n += native.intersection_count_u16(a, b)
-            else:
-                n += len(np.intersect1d(a, b, assume_unique=True))
+            n += _as_container(a).intersection_count(_as_container(b))
         return n
 
     def flip(self, start: int, end: int) -> "Bitmap":
@@ -287,7 +643,7 @@ class Bitmap:
         out = Bitmap()
         for key, c in self.containers.items():
             if s_key <= key < e_key:
-                out.containers[off_key + (key - s_key)] = c.copy()
+                out.containers[off_key + (key - s_key)] = _as_container(c).copy()
         return out
 
     # ---------------------------------------------------------- serialization
@@ -303,16 +659,18 @@ class Bitmap:
         return np.stack([c[starts], c[lasts]], axis=1)
 
     def to_bytes(self) -> bytes:
-        keys = sorted(k for k, c in self.containers.items() if len(c))
+        items = sorted(
+            (k, _as_container(c)) for k, c in self.containers.items() if len(_as_container(c))
+        )
         buf = io.BytesIO()
-        buf.write(struct.pack("<II", COOKIE, len(keys)))
+        buf.write(struct.pack("<II", COOKIE, len(items)))
 
         # Pick the smallest of array / bitmap / run per container.
         payloads = []
-        for key in keys:
-            c = self.containers[key]
-            n = len(c)
-            runs = self._runs(c)
+        for key, cont in items:
+            n = cont.n
+            arr = cont.to_array()
+            runs = self._runs(arr)
             sizes = {
                 CONTAINER_ARRAY: 2 * n,
                 CONTAINER_BITMAP: 8 * BITMAP_N,
@@ -324,24 +682,19 @@ class Bitmap:
                 del sizes[CONTAINER_ARRAY]
             typ = min(sizes, key=lambda t: (sizes[t], t))
             if typ == CONTAINER_ARRAY:
-                data = c.astype("<u2").tobytes()
+                data = arr.astype("<u2").tobytes()
             elif typ == CONTAINER_RUN:
                 data = struct.pack("<H", len(runs)) + runs.astype("<u2").tobytes()
             else:
-                words = np.zeros(BITMAP_N, dtype=np.uint64)
-                idx = c.astype(np.uint32)
-                np.bitwise_or.at(
-                    words, idx >> 6, np.uint64(1) << (idx & np.uint32(63)).astype(np.uint64)
-                )
-                data = words.astype("<u8").tobytes()
-            payloads.append((key, typ, n, data))
+                data = cont.as_words().astype("<u8").tobytes()
+            payloads.append(data)
             buf.write(struct.pack("<QHH", key, typ, n - 1))
 
-        offset = HEADER_BASE_SIZE + len(keys) * (12 + 4)
-        for _, _, _, data in payloads:
+        offset = HEADER_BASE_SIZE + len(items) * (12 + 4)
+        for data in payloads:
             buf.write(struct.pack("<I", offset))
             offset += len(data)
-        for _, _, _, data in payloads:
+        for data in payloads:
             buf.write(data)
         return buf.getvalue()
 
@@ -371,27 +724,33 @@ class Bitmap:
             if off >= len(data):
                 raise ValueError(f"offset out of bounds: off={off}, len={len(data)}")
             if typ == CONTAINER_ARRAY:
-                c = np.frombuffer(data, dtype="<u2", count=n, offset=off).astype(np.uint16)
+                arr = np.frombuffer(data, dtype="<u2", count=n, offset=off).astype(np.uint16)
+                c = Container(arr=arr, n=n)
                 ops_offset = max(ops_offset, off + 2 * n)
             elif typ == CONTAINER_BITMAP:
-                words = np.frombuffer(data, dtype="<u8", count=BITMAP_N, offset=off)
-                bits = np.unpackbits(
-                    words.view(np.uint8), bitorder="little"
+                words = np.frombuffer(data, dtype="<u8", count=BITMAP_N, offset=off).astype(
+                    np.uint64
                 )
-                c = np.flatnonzero(bits).astype(np.uint16)
+                # Dense containers stay bitsets — no value-list round trip.
+                # Cardinality is derived from the payload, not the header, so
+                # a corrupt/foreign n field cannot poison count math.
+                c = Container(bits=words)
+                n = c.n
                 ops_offset = max(ops_offset, off + 8 * BITMAP_N)
             elif typ == CONTAINER_RUN:
                 run_n = struct.unpack_from("<H", data, off)[0]
                 runs = np.frombuffer(
                     data, dtype="<u2", count=2 * run_n, offset=off + 2
                 ).reshape(run_n, 2)
-                c = (
-                    np.concatenate(
-                        [np.arange(s, l + 1, dtype=np.uint32) for s, l in runs]
+                if run_n == 0:
+                    c = Container(arr=_empty(), n=0)
+                else:
+                    # int() casts: a run ending at 65535 must not wrap uint16.
+                    arr = np.concatenate(
+                        [np.arange(int(s), int(l) + 1, dtype=np.uint32) for s, l in runs]
                     ).astype(np.uint16)
-                    if run_n
-                    else _empty()
-                )
+                    c = Container.from_sorted(arr)
+                n = c.n
                 ops_offset = max(ops_offset, off + 2 + 4 * run_n)
             else:
                 raise ValueError(f"unknown container type {typ}")
@@ -423,14 +782,7 @@ class Bitmap:
         Returns a list of problems; empty means consistent."""
         problems = []
         for key, c in self.containers.items():
-            if len(c) == 0:
-                problems.append(f"{key}: empty container present")
-                continue
-            if c.dtype != np.uint16:
-                problems.append(f"{key}: wrong dtype {c.dtype}")
-            diffs = np.diff(c.astype(np.int32))
-            if np.any(diffs <= 0):
-                problems.append(f"{key}: values not strictly ascending")
+            problems.extend(_as_container(c).check(key))
         return problems
 
 

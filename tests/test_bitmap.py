@@ -151,3 +151,94 @@ def test_header_layout():
     assert data[0:2] == (12348).to_bytes(2, "little")
     assert data[2:4] == b"\x00\x00"
     assert int.from_bytes(data[4:8], "little") == 1
+
+
+# ------------------------- two-form container behavior (round-2 rework) ----
+
+
+def test_container_densify_and_sparsify():
+    from pilosa_tpu.storage.bitmap import ARRAY_MAX_SIZE
+
+    b = Bitmap()
+    # Cross the array->bitset threshold via point adds.
+    for v in range(ARRAY_MAX_SIZE + 10):
+        assert b.add(v)
+    c = b.containers[0]
+    assert c.bits is not None and c.arr is None
+    assert b.count() == ARRAY_MAX_SIZE + 10
+    assert b.contains(17) and not b.contains(ARRAY_MAX_SIZE + 10)
+    # Remove below the hysteresis point (half the array threshold):
+    # converts back to array form.
+    for v in range(ARRAY_MAX_SIZE + 10):
+        if v % 3:
+            assert b.remove(v)
+    c = b.containers[0]
+    assert c.arr is not None and c.bits is None
+    assert b.count() == len([v for v in range(ARRAY_MAX_SIZE + 10) if v % 3 == 0])
+
+
+def test_dense_bulk_roundtrip_all_forms():
+    rng = np.random.default_rng(7)
+    vals = rng.choice(1 << 20, size=200_000, replace=False).astype(np.uint64)
+    b = Bitmap(vals)
+    assert any(c.bits is not None for c in b.containers.values())
+    # serialization round trip preserves content regardless of form
+    b2 = Bitmap.from_bytes(b.to_bytes())
+    assert b == b2
+    assert np.array_equal(b.slice(), np.sort(vals))
+
+
+def test_slice_range_walks_containers_only():
+    # values spread over many containers; range covers a partial window
+    b = Bitmap()
+    b.add_many(np.arange(0, 1 << 22, 13, dtype=np.uint64))
+    lo, hi = (1 << 18) + 5, (1 << 21) - 3
+    got = b.slice_range(lo, hi)
+    all_vals = np.arange(0, 1 << 22, 13, dtype=np.uint64)
+    want = all_vals[(all_vals >= lo) & (all_vals < hi)]
+    assert np.array_equal(got, want)
+    assert b.count_range(lo, hi) == len(want)
+
+
+def test_range_words_matches_pack_bits():
+    from pilosa_tpu.ops.bitplane import pack_bits
+
+    rng = np.random.default_rng(11)
+    width = 1 << 17  # two containers
+    cols = np.sort(rng.choice(width, size=30_000, replace=False)).astype(np.uint64)
+    b = Bitmap(cols)
+    words = b.range_words(0, width).view(np.uint32)
+    assert np.array_equal(words, pack_bits(cols.astype(np.uint32), width=width))
+
+
+def test_mixed_form_algebra_matches_oracle():
+    rng = np.random.default_rng(3)
+    dense = rng.choice(1 << 16, size=30_000, replace=False).astype(np.uint64)
+    sparse = rng.choice(1 << 16, size=500, replace=False).astype(np.uint64)
+    bd, bs = Bitmap(dense), Bitmap(sparse)
+    assert bd.containers[0].bits is not None
+    assert bs.containers[0].arr is not None
+    sd, ss = set(dense.tolist()), set(sparse.tolist())
+    for a, bb, sa, sb in [(bd, bs, sd, ss), (bs, bd, ss, sd)]:
+        assert set(a.intersect(bb).slice().tolist()) == sa & sb
+        assert set(a.union(bb).slice().tolist()) == sa | sb
+        assert set(a.difference(bb).slice().tolist()) == sa - sb
+        assert set(a.xor(bb).slice().tolist()) == sa ^ sb
+        assert a.intersection_count(bb) == len(sa & sb)
+
+
+def test_full_container_run_roundtrip():
+    # A completely full container serializes as run [0, 65535]; decode must
+    # not wrap uint16 at the +1 (would silently drop 65536 bits).
+    b = Bitmap(np.arange(1 << 16, dtype=np.uint64))
+    b2 = Bitmap.from_bytes(b.to_bytes())
+    assert b2.count() == 1 << 16
+    assert b == b2
+
+
+def test_direct_container_assignment_updates_key_cache():
+    b = Bitmap(np.arange(0, 1 << 18, 7, dtype=np.uint64))
+    _ = b.slice()  # populates the sorted-key cache
+    b.containers[1 << 10] = np.array([7], dtype=np.uint16)  # legacy direct set
+    assert b.count_range((1 << 10) << 16, ((1 << 10) + 1) << 16) == 1
+    assert ((1 << 26) | 7) in set(b.slice().tolist())

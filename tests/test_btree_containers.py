@@ -69,7 +69,7 @@ def test_bitmap_on_btree_backend(btree_backend):
     rng = random.Random(9)
     vals = sorted(rng.sample(range(1 << 22), 5000))
     b = bm.Bitmap(vals)
-    assert isinstance(b.containers, BTreeContainers)
+    assert isinstance(b.containers.store, BTreeContainers)
     assert list(b.slice()) == vals
     # Serialization round-trip through the B-tree backend.
     b2 = bm.Bitmap.from_bytes(b.to_bytes())
@@ -80,7 +80,7 @@ def test_bitmap_on_btree_backend(btree_backend):
     assert set(b.difference(other).slice().tolist()) == set(vals[1::2])
     # Mutation + clone keeps the backend.
     c = b.clone()
-    assert isinstance(c.containers, BTreeContainers)
+    assert isinstance(c.containers.store, BTreeContainers)
     assert c.remove(vals[0])
     assert not c.contains(vals[0])
     assert b.contains(vals[0])
