@@ -141,6 +141,9 @@ class ResizeCoordinator:
             cluster = self.server.cluster
             cluster.nodes = job.new_nodes
             cluster.state = STATE_NORMAL
+            # Checkpoint membership so a restarting coordinator knows which
+            # nodes to wait for (startup topology quorum).
+            self.server.topology.save(job.new_nodes)
             self.server.broadcast_message(
                 {
                     "type": "cluster-status",

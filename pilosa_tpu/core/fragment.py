@@ -454,32 +454,67 @@ class Fragment:
         included. Returns (sets, clears) diffs per input replica, majority
         vote over {local} ∪ replicas, and applies the local diff.
         """
-        local_rows, local_cols = self.block_data(block_id)
-        all_sets = [set(zip(local_rows.tolist(), local_cols.tolist()))]
+        # Vote on flat bit positions with numpy set ops — a dense 100-row
+        # block holds up to 100 * 2^20 bits, so per-pair Python objects
+        # (sets of tuples) are out of the question at scale.
+        block_width = HASH_BLOCK_SIZE * SHARD_WIDTH
+        base_pos = np.uint64(block_id * block_width)
+        local_pos = self.storage.slice_range(
+            block_id * block_width, (block_id + 1) * block_width
+        ) - base_pos
+        positions = [local_pos]
         for rows, cols in data:
-            all_sets.append(set(zip(np.asarray(rows).tolist(), np.asarray(cols).tolist())))
+            pos = np.asarray(rows, dtype=np.uint64) * np.uint64(SHARD_WIDTH) + np.asarray(
+                cols, dtype=np.uint64
+            ) - base_pos
+            positions.append(np.unique(pos))
         # Even splits keep the bit (reference fragment.go:1218 majorityN =
         # (n+1)/2 with setN >= majorityN).
-        majority = (len(all_sets) + 1) // 2
-        votes: Dict[Tuple[int, int], int] = {}
-        for s in all_sets:
-            for pair in s:
-                votes[pair] = votes.get(pair, 0) + 1
-        consensus = {p for p, v in votes.items() if v >= majority}
+        majority = (len(positions) + 1) // 2
+        uniq, counts = np.unique(np.concatenate(positions), return_counts=True)
+        consensus = uniq[counts >= majority]
+
+        def pairs(pos: np.ndarray) -> List[Tuple[int, int]]:
+            p = pos + base_pos
+            rows = (p // np.uint64(SHARD_WIDTH)).tolist()
+            cols = (p % np.uint64(SHARD_WIDTH)).tolist()
+            return list(zip(map(int, rows), map(int, cols)))
 
         sets_out, clears_out = [], []
-        for i, s in enumerate(all_sets):
-            add = sorted(consensus - s)
-            rem = sorted(s - consensus)
+        for i, pos in enumerate(positions):
+            add = np.setdiff1d(consensus, pos, assume_unique=True)
+            rem = np.setdiff1d(pos, consensus, assume_unique=True)
             if i == 0:
-                for r, c in add:
-                    self.set_bit(int(r), int(self.shard * SHARD_WIDTH + c))
-                for r, c in rem:
-                    self.clear_bit(int(r), int(self.shard * SHARD_WIDTH + c))
+                self._apply_merge_diff(add + base_pos, rem + base_pos)
             else:
-                sets_out.append(add)
-                clears_out.append(rem)
+                sets_out.append(pairs(add))
+                clears_out.append(pairs(rem))
         return sets_out, clears_out
+
+    # Above this many local diff bits, anti-entropy applies the merge in
+    # bulk (storage-level scatter + one snapshot) instead of per-bit
+    # set/clear with per-op WAL appends.
+    MERGE_BULK_THRESHOLD = 256
+
+    def _apply_merge_diff(self, add_pos: np.ndarray, rem_pos: np.ndarray) -> None:
+        if len(add_pos) + len(rem_pos) <= self.MERGE_BULK_THRESHOLD:
+            sw = np.uint64(SHARD_WIDTH)
+            base = self.shard * SHARD_WIDTH
+            for p in add_pos:
+                self.set_bit(int(p // sw), base + int(p % sw))
+            for p in rem_pos:
+                self.clear_bit(int(p // sw), base + int(p % sw))
+            return
+        self.storage.add_many(add_pos)
+        self.storage.remove_many(rem_pos)
+        touched = np.unique(
+            np.concatenate([add_pos, rem_pos]) // np.uint64(SHARD_WIDTH)
+        )
+        for row_id in touched:
+            self._invalidate_row(int(row_id))
+            self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
+        self.cache.invalidate(force=True)
+        self.snapshot()
 
     # --------------------------------------------------------------- import
 

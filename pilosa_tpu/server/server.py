@@ -15,7 +15,7 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
-from ..cluster.node import Cluster, Node, STATE_NORMAL, STATE_RESIZING
+from ..cluster.node import Cluster, Node, STATE_NORMAL, STATE_RESIZING, STATE_STARTING
 from ..core.holder import Holder
 from ..errors import PilosaError
 from ..executor import Executor
@@ -206,7 +206,20 @@ class Server:
             self.cluster.nodes.sort(key=lambda n: n.id)
 
         self.holder.open()
-        self.cluster.state = STATE_NORMAL
+        if self._needs_topology_quorum():
+            # Reference considerTopology + haveTopologyAgreement
+            # (cluster.go:1582-1613, 941-946): a restarting coordinator with
+            # a persisted multi-node topology stays STARTING until every
+            # previously-known node rejoins — serving or resizing against a
+            # partial cluster could lose acknowledged writes.
+            self.cluster.state = STATE_STARTING
+            pending = sorted(set(self.topology.node_ids) - {self.node.id})
+            self.logger.info(
+                "cluster STARTING: waiting for topology quorum, pending nodes: %s",
+                pending,
+            )
+        else:
+            self.cluster.state = STATE_NORMAL
 
         if self.anti_entropy_interval > 0 and self.cluster.replica_n > 1:
             self._spawn(self._monitor_anti_entropy, self.anti_entropy_interval)
@@ -220,11 +233,34 @@ class Server:
             self._spawn(self._monitor_diagnostics, self.diagnostics.interval)
         if self.member_monitor_interval > 0 and len(self.cluster.nodes) > 1:
             self._spawn(self._monitor_members, self.member_monitor_interval)
-        self.topology.save(self.cluster.nodes)
+        if self.cluster.state == STATE_NORMAL:
+            # While STARTING on topology quorum the persisted node list is
+            # the source of truth for who must rejoin — don't clobber it
+            # with the partial membership.
+            self.topology.save(self.cluster.nodes)
         self.opened = True
         if self.join_addr:
             self._join_cluster()
         return self
+
+    def _needs_topology_quorum(self) -> bool:
+        """True when this coordinator must wait for previously-known nodes
+        before going NORMAL. Static clusters skip the check (the reference's
+        Static mode does too); joiners are admitted by the coordinator."""
+        if self._static_hosts or self.join_addr or not self.node.is_coordinator:
+            return False
+        known = set(self.topology.node_ids)
+        if not known or known == {self.node.id}:
+            return False
+        if self.node.id not in known:
+            raise PilosaError(
+                f"coordinator {self.node.id} is not in topology: "
+                f"{self.topology.node_ids}"
+            )
+        return not known <= {n.id for n in self.cluster.nodes}
+
+    def _topology_agreement_reached(self) -> bool:
+        return set(self.topology.node_ids) <= {n.id for n in self.cluster.nodes}
 
     def _join_cluster(self) -> None:
         """Join an existing cluster (the reference's gossip join event,
@@ -240,12 +276,13 @@ class Server:
         )
         deadline = time.time() + 30
         while time.time() < deadline:
-            if (
-                len(self.cluster.nodes) > 1
-                and self.cluster.state == STATE_NORMAL
-                and self.cluster.node_by_id(self.node.id)
-            ):
-                return
+            if len(self.cluster.nodes) > 1 and self.cluster.node_by_id(self.node.id):
+                # Admission while the coordinator is STARTING on topology
+                # quorum counts as a successful join: the cluster goes
+                # NORMAL once the remaining known nodes arrive, which may
+                # take arbitrarily long in a staggered restart.
+                if self.cluster.state in (STATE_NORMAL, STATE_STARTING):
+                    return
             time.sleep(0.05)
         raise PilosaError(f"timed out joining cluster via {self.join_addr}")
 
@@ -261,6 +298,26 @@ class Server:
             return
         if self.cluster.node_by_id(node.id) is not None:
             # Already a member: re-send the cluster status (idempotent join).
+            self.client.send_message(node, self._status_message())
+            return
+        if self.cluster.state == STATE_STARTING and self.topology.node_ids:
+            # Topology-quorum mode (reference nodeJoin, cluster.go:1641-1662):
+            # these are prior members rejoining after a restart, NOT a
+            # membership change — no resize. Unknown hosts are refused until
+            # the cluster is NORMAL.
+            if node.id not in self.topology.node_ids:
+                self.logger.info("refusing join during STARTING: %s not in topology",
+                                 node.id)
+                return
+            self.cluster.add_node(node)
+            if self._topology_agreement_reached():
+                self.cluster.state = STATE_NORMAL
+                self.topology.save(self.cluster.nodes)
+                self.logger.info("topology quorum reached; cluster NORMAL")
+                self.broadcast_message(self._status_message())
+            # While still STARTING, only the rejoining node hears back —
+            # broadcasting partial membership would make peers overwrite
+            # their persisted topology with an incomplete node list.
             self.client.send_message(node, self._status_message())
             return
         new_nodes = sorted(self.cluster.nodes + [node], key=lambda n: n.id)
@@ -475,7 +532,11 @@ class Server:
             prev_state = self.cluster.state
             self.cluster.state = msg.get("state", self.cluster.state)
             self.cluster.nodes = [Node.from_dict(n) for n in msg.get("nodes", [])]
-            self.topology.save(self.cluster.nodes)
+            if self.cluster.state == STATE_NORMAL:
+                # Only NORMAL membership is checkpointed: a STARTING status
+                # carries partial membership and must not clobber the
+                # persisted topology peers use for their own quorum.
+                self.topology.save(self.cluster.nodes)
             if prev_state == STATE_RESIZING and self.cluster.state == STATE_NORMAL:
                 # Post-resize GC of shards this node no longer owns
                 # (reference holderCleaner, holder.go:777-835).

@@ -252,3 +252,49 @@ def test_write_read_roundtrip(tmp_path):
     assert list(g.row(1).columns()) == [10]
     assert list(g.row(2).columns()) == [20]
     assert g.cache.get(1) == 1
+
+
+def test_merge_block_dense_scale(tmp_path):
+    """Anti-entropy consensus over a dense block (>1M bits) must run at
+    numpy speed, not per-pair Python objects (fragment.go:1176-1293)."""
+    import time
+
+    from pilosa_tpu.constants import SHARD_WIDTH
+
+    f = make_fragment(tmp_path)
+    # Local replica: rows 0-1 dense (even columns), plus noise missing
+    # from the others.
+    local = np.arange(0, SHARD_WIDTH, 2, dtype=np.uint64)
+    f.bulk_import(np.zeros(len(local), dtype=np.uint64), local)
+    f.bulk_import(np.ones(len(local), dtype=np.uint64), local)
+    # Replica A: same + extra bits; replica B: same as A. 2-of-3 majority
+    # should adopt the extras locally.
+    extra = np.arange(1, 200_001, 2, dtype=np.uint64)  # odd cols, row 0
+    rows_a = np.concatenate([np.zeros(len(local) + len(extra), dtype=np.uint64),
+                             np.ones(len(local), dtype=np.uint64)])
+    cols_a = np.concatenate([local, extra, local])
+    t0 = time.monotonic()
+    sets, clears = f.merge_block(0, [(rows_a, cols_a), (rows_a.copy(), cols_a.copy())])
+    dt = time.monotonic() - t0
+    assert dt < 10.0, f"dense merge too slow: {dt:.1f}s"
+    # Local fragment adopted the majority extras.
+    assert f.row_count(0) == len(local) + len(extra)
+    assert f.row_count(1) == len(local)
+    # Replicas already agree with consensus: no diffs pushed back.
+    assert sets == [[], []] and clears == [[], []]
+
+
+def test_merge_block_pushes_diffs_to_minority_replica(tmp_path):
+    from pilosa_tpu.constants import SHARD_WIDTH
+
+    f = make_fragment(tmp_path)
+    f.set_bit(0, 1)
+    f.set_bit(0, 2)
+    # Replica agrees on bit 1 and has a spurious bit 5; majority of 2
+    # ((2+1)//2 = 1 vote needed) keeps everything -> local adopts 5,
+    # replica is told to set 2.
+    sets, clears = f.merge_block(0, [(np.array([0, 0], dtype=np.uint64),
+                                      np.array([1, 5], dtype=np.uint64))])
+    assert f.bit(0, 5)
+    assert (0, 2) in sets[0]
+    assert clears[0] == []

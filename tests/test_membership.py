@@ -136,3 +136,60 @@ def test_remove_node(tmp_path):
                 s.close()
             except Exception:
                 pass
+
+
+def test_coordinator_startup_quorum(tmp_path):
+    """A restarting coordinator with a persisted multi-node topology stays
+    STARTING (rejecting queries) until the previously-known nodes rejoin
+    (reference considerTopology, cluster.go:1582-1613)."""
+    from pilosa_tpu.errors import PilosaError
+
+    port0, port1 = free_port(), free_port()
+    s0 = make_server(tmp_path, "n0", port0)
+    client = InternalClient()
+    client.create_index(s0.node.uri, "q")
+    client.create_field(s0.node.uri, "q", "f")
+    client.query(s0.node.uri, "q", "Set(1, f=1)")
+    s1 = make_server(tmp_path, "n1", port1, join_addr=s0.node.uri)
+    assert wait_for(lambda: len(s0.cluster.nodes) == 2 and s0.cluster.state == "NORMAL")
+    s1_id = s1.node.id
+    s1.close()
+    s0.close()
+
+    # Coordinator restarts alone: topology on disk lists both nodes.
+    s0 = make_server(tmp_path, "n0", port0)
+    try:
+        assert s0.cluster.state == "STARTING"
+        with pytest.raises(PilosaError):
+            s0.api.query("q", "Count(Row(f=1))")
+        # The previously-known node rejoins (same port -> same id): NORMAL.
+        s1 = make_server(tmp_path, "n1", port1, join_addr=s0.node.uri)
+        assert wait_for(lambda: s0.cluster.state == "NORMAL")
+        assert {n.id for n in s0.cluster.nodes} == {s0.node.id, s1_id}
+        assert s0.api.query("q", "Count(Row(f=1))")
+        s1.close()
+    finally:
+        s0.close()
+
+
+def test_startup_quorum_refuses_unknown_host(tmp_path):
+    port0 = free_port()
+    s0 = make_server(tmp_path, "n0", port0)
+    client = InternalClient()
+    client.create_index(s0.node.uri, "q2")
+    s1 = make_server(tmp_path, "n1", free_port(), join_addr=s0.node.uri)
+    assert wait_for(lambda: len(s0.cluster.nodes) == 2)
+    s1.close()
+    s0.close()
+
+    s0 = make_server(tmp_path, "n0", port0)
+    try:
+        assert s0.cluster.state == "STARTING"
+        # A brand-new host (different port/id) is refused while STARTING.
+        from pilosa_tpu.errors import PilosaError
+
+        with pytest.raises(PilosaError):
+            make_server(tmp_path, "n2", free_port(), join_addr=s0.node.uri)
+        assert s0.cluster.state == "STARTING"
+    finally:
+        s0.close()

@@ -253,3 +253,87 @@ def test_diagnostics_version_compare():
     assert w and "patch release" in w
     # Unreachable endpoint: swallowed, returns None.
     assert d.check_version("http://127.0.0.1:1/none") is None
+
+
+def test_translate_store_binary_log_reopen(tmp_path):
+    """Offset-indexed binary log: keys round-trip across reopen with only
+    offsets held in memory (reference translate.go:733-900)."""
+    from pilosa_tpu.translate import TranslateStore
+
+    path = str(tmp_path / "keys")
+    ts = TranslateStore(path).open()
+    ids = ts.translate_columns_to_uint64("i", [f"user-{n}" for n in range(500)])
+    assert ids == list(range(1, 501))
+    rids = ts.translate_rows_to_uint64("i", "f", ["alpha", "beta", "alpha"])
+    assert rids == [1, 2, 1]
+    ts.close()
+
+    ts2 = TranslateStore(path).open()
+    # existing keys resolve to the same ids; new keys continue the sequence
+    assert ts2.translate_columns_to_uint64("i", ["user-7", "user-new"]) == [8, 501]
+    assert ts2.translate_column_to_string("i", 8) == "user-7"
+    assert ts2.translate_row_to_string("i", "f", 2) == "beta"
+    assert ts2.translate_rows_to_string("i", "f", [1, 2, 99]) == ["alpha", "beta", ""]
+    ts2.close()
+
+
+def test_translate_store_legacy_json_migration(tmp_path):
+    import json as _json
+    import struct as _struct
+
+    from pilosa_tpu.translate import TranslateStore
+
+    path = str(tmp_path / "keys")
+    with open(path, "wb") as f:
+        for ns, key, id in [("i:x", "a", 1), ("i:x", "b", 2), ("f:x:g", "r", 1)]:
+            e = _json.dumps([ns, key, id]).encode()
+            f.write(_struct.pack("<I", len(e)) + e)
+    ts = TranslateStore(path).open()
+    assert ts.translate_columns_to_uint64("x", ["a", "b", "c"]) == [1, 2, 3]
+    assert ts.translate_row_to_string("x", "g", 1) == "r"
+    ts.close()
+    # migrated file reopens as binary
+    ts2 = TranslateStore(path).open()
+    assert ts2.translate_column_to_string("x", 3) == "c"
+    ts2.close()
+
+
+def test_translate_store_memory_is_offsets_not_keys(tmp_path):
+    """1M keys must not hold 1M python strings resident."""
+    import sys
+
+    from pilosa_tpu.translate import TranslateStore
+
+    ts = TranslateStore(str(tmp_path / "keys")).open()
+    n = 100_000
+    CHUNK = 10_000
+    for i in range(0, n, CHUNK):
+        ts.translate_columns_to_uint64("big", [f"key-{j:012d}" for j in range(i, i + CHUNK)])
+    # table slots + id offsets are numpy/array-backed: ~16B/key, far below
+    # what 100k resident str objects (~60B+ each) would need.
+    table_bytes = ts._table.slots.nbytes
+    ids_bytes = sum(a.itemsize * len(a) for a in ts._ids.values())
+    assert table_bytes + ids_bytes < 6_000_000
+    assert ts.translate_columns_to_uint64("big", ["key-000000000042"]) == [43]
+    assert ts.translate_column_to_string("big", 43) == "key-000000000042"
+    ts.close()
+
+
+def test_translate_store_truncated_tail_recovery(tmp_path):
+    """A crash mid-append leaves a partial entry; reopen must truncate it so
+    new entries land at clean offsets."""
+    from pilosa_tpu.translate import TranslateStore
+
+    path = str(tmp_path / "keys")
+    ts = TranslateStore(path).open()
+    ts.translate_columns_to_uint64("i", ["a", "b"])
+    ts.close()
+    with open(path, "ab") as f:
+        f.write(b"\xff\x00\x00\x00partial")  # garbage tail
+    ts2 = TranslateStore(path).open()
+    assert ts2.translate_columns_to_uint64("i", ["a", "c"]) == [1, 3]
+    ts2.close()
+    ts3 = TranslateStore(path).open()
+    assert ts3.translate_column_to_string("i", 3) == "c"
+    assert ts3.translate_columns_to_uint64("i", ["c"]) == [3]
+    ts3.close()
