@@ -44,10 +44,13 @@ class InternalClient:
             self._ssl_context.verify_mode = ssl.CERT_NONE
 
     def _request(self, method: str, url: str, body: Optional[bytes] = None,
-                 content_type: str = "application/json") -> bytes:
+                 content_type: str = "application/json",
+                 accept: Optional[str] = None) -> bytes:
         req = urllib.request.Request(url, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
+        if accept:
+            req.add_header("Accept", accept)
         kwargs = {"context": self._ssl_context} if url.startswith("https") else {}
         try:
             with urllib.request.urlopen(req, timeout=self.timeout, **kwargs) as resp:
@@ -63,12 +66,19 @@ class InternalClient:
     def query_node(self, node, index: str, query: str,
                    shards: Optional[Sequence[int]] = None, remote: bool = True) -> List[Any]:
         """Execute PQL on a peer restricted to its shards (http/client.go QueryNode)."""
+        from . import wire
+
         params = {"remote": "true"} if remote else {}
         url = f"{_node_url(node)}/index/{index}/query"
         if params:
             url += "?" + urllib.parse.urlencode(params)
         body = json.dumps({"query": query, "shards": list(shards) if shards else None}).encode()
-        data = json.loads(self._request("POST", url, body))
+        raw = self._request("POST", url, body, accept=wire.CONTENT_TYPE)
+        # Binary data plane when the peer speaks it (packed bitplanes);
+        # JSON fallback keeps mixed-version clusters working.
+        if wire.is_wire(raw):
+            return wire.decode_results(raw)
+        data = json.loads(raw)
         if "error" in data:
             raise ClientError(data["error"])
         return [deserialize_remote(r) for r in data["results"]]

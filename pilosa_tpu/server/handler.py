@@ -288,6 +288,12 @@ class Handler:
 
         if remote:
             results = self.api.query(index, pql, shards=shards, remote=True)
+            from . import wire
+
+            if wire.CONTENT_TYPE in headers.get("accept", ""):
+                # Binary data plane: packed bitplanes instead of JSON column
+                # lists (a dense 1M-column Row is 128KiB, not ~10MB).
+                return 200, wire.CONTENT_TYPE, wire.encode_results(results)
             return {"results": [serialize_remote(r) for r in results]}
         return self.api.query_response(
             index, pql, shards=shards, column_attrs=column_attrs,

@@ -1,0 +1,64 @@
+"""Binary node-to-node wire codec tests (reference ships protobuf
+QueryResponses between nodes, internal/private.proto; this framework ships
+packed bitplanes)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.core.cache import Pair
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.executor import ValCount
+from pilosa_tpu.server import wire
+
+
+def test_roundtrip_mixed_results():
+    dense_cols = np.arange(0, SHARD_WIDTH, 2, dtype=np.uint64)
+    sparse_cols = np.array([5, 99, SHARD_WIDTH + 7], dtype=np.uint64)
+    row = Row(columns=np.concatenate([dense_cols, sparse_cols]))
+    row.attrs = {"x": 1}
+    results = [
+        row,
+        ValCount(val=42, count=7),
+        [Pair(id=1, count=10), Pair(id=2, count=5, key="k")],
+        True,
+        12345,
+        None,
+    ]
+    data = wire.encode_results(results)
+    assert wire.is_wire(data)
+    out = wire.decode_results(data)
+    assert np.array_equal(out[0].columns(), row.columns())
+    assert out[0].attrs == {"x": 1}
+    assert out[1].val == 42 and out[1].count == 7
+    assert [(p.id, p.count, p.key) for p in out[2]] == [(1, 10, ""), (2, 5, "k")]
+    assert out[3] is True
+    assert out[4] == 12345
+    assert out[5] is None
+
+
+def test_dense_row_is_compact():
+    """A dense 1M-column row must ship as a plane (~128KiB), not a column
+    list (~8MB binary / ~10MB JSON)."""
+    import json
+
+    from pilosa_tpu.server.handler import serialize_remote
+
+    row = Row(columns=np.arange(0, SHARD_WIDTH, dtype=np.uint64))
+    data = wire.encode_results([row])
+    assert len(data) < 150_000
+    json_len = len(json.dumps(serialize_remote(row)))
+    assert len(data) * 10 < json_len
+
+
+def test_sparse_row_is_column_form():
+    row = Row(columns=np.array([3, 10_000], dtype=np.uint64))
+    data = wire.encode_results([row])
+    assert len(data) < 1000
+    out = wire.decode_results(data)
+    assert out[0].columns().tolist() == [3, 10_000]
+
+
+def test_decode_rejects_garbage():
+    with pytest.raises(ValueError):
+        wire.decode_results(b"{\"results\": []}")
