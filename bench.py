@@ -1,19 +1,29 @@
 """Benchmark: PQL Count(Intersect) + TopN throughput on device vs host.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "...", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "...", "vs_baseline": N, "detail": {...}}
 
 The workload is BASELINE.md's north-star shape scaled to one chip: a
 multi-shard index, Count(Intersect(Row,Row)) and TopN served from the
 sharded device engine. vs_baseline compares against the same queries
-executed with CPU bitmap ops (the host roaring-container path — the moral
-equivalent of the reference's Go hot loop, which is also CPU bitmap math),
-measured in this same process. >1.0 means the device path is faster.
+executed with the STRONGEST available host path — the native C kernel
+(and_count_words over packed planes, pilosa_tpu/native/bitmap_ops.cpp) when
+it loads, else a numpy fallback — measured in this same process. >1.0 means
+the device path is faster.
+
+Backend bring-up is deliberately paranoid (the TPU tunnel can be down):
+the default backend is probed in a subprocess with retries + backoff, every
+probe's outcome (rc, elapsed, stderr tail) is recorded in detail.probes so
+a dead tunnel is distinguishable from broken code, and BENCH_REQUIRE_TPU=1
+exits non-zero instead of silently benchmarking the CPU.
 
 Env knobs: BENCH_SHARDS (default 8), BENCH_ROWS (default 128),
 BENCH_DENSITY (default 0.02), BENCH_ITERS (default 128, capped at
-BENCH_ROWS so batches contain no duplicate queries; effective value is
-reported as detail.iters).
+BENCH_ROWS so batches contain no duplicate queries), BENCH_PROBE_TIMEOUT
+(per-attempt seconds, default 150), BENCH_PROBE_ATTEMPTS (default 3),
+BENCH_REQUIRE_TPU=1 (fail instead of CPU fallback), BENCH_FORCE_PLATFORM,
+BENCH_PALLAS=0 (skip kernel stanza), BENCH_SCALE=0 (skip HBM-pressure
+stanza).
 """
 
 import json
@@ -25,31 +35,135 @@ import time
 import numpy as np
 
 
-def _ensure_live_backend(timeout=120):
-    """Probe the default jax backend in a subprocess; if it can't
-    initialize (e.g. the TPU tunnel is down), fall back to CPU so the
-    bench always prints its JSON line instead of hanging forever."""
+# ------------------------------------------------------- backend bring-up
+
+
+def _probe_once(platform, timeout):
+    """Initialize a jax backend + run one op in a subprocess. Returns a
+    diagnostic dict; never raises. `platform` None probes the environment's
+    default backend (the TPU tunnel under axon)."""
+    cfg = (
+        f"jax.config.update('jax_platforms', {platform!r})\n" if platform else ""
+    )
+    code = (
+        "import jax\n" + cfg +
+        "import jax.numpy as jnp\n"
+        "d = jax.devices()\n"
+        "jnp.zeros(8).block_until_ready()\n"
+        "print('BENCH_PROBE_OK platform=%s kind=%s n=%d'\n"
+        "      % (d[0].platform, getattr(d[0], 'device_kind', '?'), len(d)))\n"
+    )
+    t0 = time.perf_counter()
+    diag = {"platform": platform or "default", "timeout_s": timeout}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout, capture_output=True, text=True,
+        )
+        diag["rc"] = r.returncode
+        diag["ok"] = r.returncode == 0 and "BENCH_PROBE_OK" in r.stdout
+        if diag["ok"]:
+            report = [
+                l for l in r.stdout.splitlines() if "BENCH_PROBE_OK" in l
+            ][0]
+            diag["report"] = report
+            diag["probed_platform"] = report.split("platform=")[1].split()[0]
+        else:
+            diag["stderr_tail"] = r.stderr[-800:]
+    except subprocess.TimeoutExpired as e:
+        diag["rc"] = "timeout"
+        diag["ok"] = False
+        stderr = e.stderr or b""
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode("utf-8", "replace")
+        diag["stderr_tail"] = stderr[-800:]
+    except Exception as e:  # pragma: no cover - probe must never kill bench
+        diag["rc"] = f"error: {type(e).__name__}: {e}"
+        diag["ok"] = False
+    diag["elapsed_s"] = round(time.perf_counter() - t0, 1)
+    return diag
+
+
+def _ensure_live_backend():
+    """Pick a live backend without ever hanging the bench.
+
+    Returns (platform_label, probes) where probes is the full diagnostic
+    trail. Tries the default backend (the TPU) BENCH_PROBE_ATTEMPTS times
+    with backoff, then an explicit 'tpu' platform once (in case the default
+    was overridden), and only then falls back to CPU — unless
+    BENCH_REQUIRE_TPU=1, in which case it prints the JSON line with the
+    probe trail and exits non-zero so the wrong hardware is never
+    benchmarked silently."""
+    probes = []
+    require_tpu = os.environ.get("BENCH_REQUIRE_TPU") == "1"
+    tpu_platforms = ("tpu", "axon")
     forced = os.environ.get("BENCH_FORCE_PLATFORM")
-    if forced:
+    if forced and not (require_tpu and forced not in tpu_platforms):
         import jax
 
         jax.config.update("jax_platforms", forced)
-        return forced
-    try:
-        subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); import jax.numpy as jnp; "
-             "jnp.zeros(8).block_until_ready()"],
-            check=True, timeout=timeout, capture_output=True,
-        )
-        return "default"
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        return forced, [{"platform": forced, "ok": True, "forced": True}]
+
+    timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+    for i in range(attempts):
+        diag = _probe_once(None, timeout)
+        diag["attempt"] = i + 1
+        probes.append(diag)
+        if diag["ok"]:
+            # REQUIRE_TPU must not accept an environment whose default
+            # backend is the CPU: check what the probe actually found.
+            if require_tpu and diag.get("probed_platform") not in tpu_platforms:
+                diag["rejected"] = "default backend is not a TPU"
+            else:
+                return "default", probes
+        time.sleep(min(5 * (i + 1), 15))
+    # The default platform may have been overridden to something dead;
+    # explicitly ask for a 'tpu' platform once. Under axon the TPU platform
+    # is registered as 'axon' so this usually errors fast — the recorded
+    # error proves which platforms exist in the environment.
+    diag = _probe_once("tpu", min(timeout, 60))
+    probes.append(diag)
+    if diag["ok"]:
         import jax
 
-        jax.config.update("jax_platforms", "cpu")
-        print("bench: default backend unavailable; falling back to CPU",
-              file=sys.stderr)
-        return "cpu"
+        jax.config.update("jax_platforms", "tpu")
+        return "tpu", probes
+
+    if require_tpu:
+        print(json.dumps({
+            "metric": "count_intersect_qps_8shards",
+            "value": 0,
+            "unit": "queries/sec",
+            "vs_baseline": 0,
+            "detail": {"error": "BENCH_REQUIRE_TPU=1 and no TPU backend came up",
+                       "probes": probes},
+        }))
+        sys.exit(1)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    print("bench: default backend unavailable; falling back to CPU "
+          f"(probe trail: {json.dumps(probes)})", file=sys.stderr)
+    return "cpu", probes
+
+
+def _device_info():
+    import jax
+
+    d = jax.devices()[0]
+    return {"platform": d.platform,
+            "device_kind": getattr(d, "device_kind", "?"),
+            "n_devices": len(jax.devices())}
+
+
+def _on_tpu_platform():
+    import jax
+
+    return jax.devices()[0].platform in ("tpu", "axon")
+
+
+# ------------------------------------------------------------- main bench
 
 
 def build(n_shards, n_rows, density):
@@ -114,33 +228,225 @@ def bench_device(ex, n_rows, n_shards, iters):
 
 
 def bench_host(holder, n_rows, n_shards, iters):
-    """Same Count(Intersect) math with CPU container ops (baseline)."""
+    """Same Count(Intersect) math on the strongest host path available.
+
+    Primary baseline: the native C kernel `and_count_words` over packed
+    uint32 planes (pilosa_tpu/native/bitmap_ops.cpp:45) — the closest moral
+    equivalent of the reference's Go popcount loops. A numpy value-list
+    intersect is also measured; the FASTER of the two is the baseline so
+    vs_baseline never flatters the device. Returns (qps, detail)."""
+    from pilosa_tpu import native
+    from pilosa_tpu.constants import SHARD_WIDTH
+
     frags = [
         holder.fragment("bench", "f", "standard", s) for s in range(n_shards)
     ]
-    from pilosa_tpu.constants import SHARD_WIDTH
 
+    results = {}
+
+    lib = native.load()
+    if lib is not None:
+        # Pre-coerce once so the timed loop exercises the typed wrapper
+        # (native.and_count_words) without per-call copies.
+        planes = {
+            row: [np.ascontiguousarray(f.plane_np(row), dtype=np.uint32)
+                  for f in frags]
+            for row in range(n_rows)
+        }
+        done = 0
+        start = time.perf_counter()
+        while done < 3 or time.perf_counter() - start < 1.5:
+            a, b = done % n_rows, (done + 1) % n_rows
+            total = 0
+            for pa, pb in zip(planes[a], planes[b]):
+                total += native.and_count_words(pa, pb)
+            done += 1
+        results["native_c_qps"] = done / (time.perf_counter() - start)
+
+    # numpy value-list baseline (pre-extracted sorted column arrays).
     def host_row(frag, row):
-        start = row * SHARD_WIDTH
-        return frag.storage.slice_range(start, start + SHARD_WIDTH)
+        start_pos = row * SHARD_WIDTH
+        return frag.storage.slice_range(start_pos, start_pos + SHARD_WIDTH)
 
-    # Pre-extract per-shard row arrays (favors the baseline: no extraction
-    # cost inside the timed loop).
-    cache = {}
-    for row in range(n_rows):
-        cache[row] = [host_row(f, row) for f in frags]
-
-    # Time-bounded loop (≥1.5s) so the baseline is stable run to run.
+    cache = {row: [host_row(f, row) for f in frags] for row in range(n_rows)}
     done = 0
     start = time.perf_counter()
     while done < 3 or time.perf_counter() - start < 1.5:
-        i = done
-        a, b = i % n_rows, (i + 1) % n_rows
+        a, b = done % n_rows, (done + 1) % n_rows
         total = 0
         for sa, sb in zip(cache[a], cache[b]):
             total += len(np.intersect1d(sa, sb, assume_unique=True))
         done += 1
-    return done / (time.perf_counter() - start)
+    results["numpy_qps"] = done / (time.perf_counter() - start)
+
+    best = max(results, key=results.get)
+    return results[best], {"method": best,
+                           **{k: round(v, 2) for k, v in results.items()}}
+
+
+# ------------------------------------------------- Pallas kernel validation
+
+
+def bench_pallas():
+    """Run the Pallas kernels COMPILED (not interpret) on the live device
+    and compare against the plain-XLA formulations of the same ops.
+
+    Returns a detail dict with words/sec per kernel — or the error that
+    proves where compilation fails on this hardware (the gather kernel's
+    scalar-prefetch DMA indexing can only be validated on a real chip)."""
+    out = {}
+    if not _on_tpu_platform():
+        out["skipped"] = "not on a TPU backend (interpret mode would not validate the kernels)"
+        return out
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(7)
+
+    def timeit(fn, *args, reps=20):
+        fn(*args).block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = fn(*args)
+        r.block_until_ready()
+        return (time.perf_counter() - t0) / reps
+
+    # --- fused_nary_count: Intersect of 2 planes, 8 MiB per plane.
+    n_words = 1 << 21
+    try:
+        a = jnp.asarray(rng.integers(0, 1 << 32, n_words, dtype=np.uint32))
+        b = jnp.asarray(rng.integers(0, 1 << 32, n_words, dtype=np.uint32))
+        tape = ((pk.OP_AND, 0, 1),)
+        xla_fn = jax.jit(
+            lambda x, y: jnp.sum(jax.lax.population_count(jnp.bitwise_and(x, y)).astype(jnp.int32))
+        )
+        want = int(xla_fn(a, b))
+        got = int(pk.fused_nary_count(tape, a, b))
+        assert got == want, (got, want)
+        t_pallas = timeit(lambda x, y: pk.fused_nary_count(tape, x, y), a, b)
+        t_xla = timeit(xla_fn, a, b)
+        out["fused_nary_count"] = {
+            "gwords_per_s": round(n_words / t_pallas / 1e9, 2),
+            "xla_gwords_per_s": round(n_words / t_xla / 1e9, 2),
+            "vs_xla": round(t_xla / t_pallas, 3),
+            "verified": True,
+        }
+    except Exception as e:
+        out["fused_nary_count"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    # --- batched_gather_expr_count: Q=64 2-leaf queries over a (64, 8, W)
+    # resident stack (the scalar-prefetch DMA path).
+    try:
+        from pilosa_tpu.constants import WORDS_PER_ROW
+
+        U, S, Q = 64, 8, 64
+        stacked = jnp.asarray(
+            rng.integers(0, 1 << 32, (U, S, WORDS_PER_ROW), dtype=np.uint32)
+        )
+        idx_a = jnp.asarray(rng.integers(0, U, Q, dtype=np.int32))
+        idx_b = jnp.asarray(rng.integers(0, U, Q, dtype=np.int32))
+        expr = lambda planes: jnp.bitwise_and(planes[0], planes[1])
+
+        @jax.jit
+        def gather_kernel(stacked, ia, ib):
+            return pk.batched_gather_expr_count(stacked, (ia, ib), expr)
+
+        @jax.jit
+        def gather_xla(stacked, ia, ib):
+            plane = jnp.bitwise_and(stacked[ia], stacked[ib])
+            return jnp.sum(
+                jax.lax.population_count(plane).astype(jnp.int32), axis=(1, 2)
+            )
+
+        got = np.asarray(gather_kernel(stacked, idx_a, idx_b))
+        want = np.asarray(gather_xla(stacked, idx_a, idx_b))
+        assert (got == want).all(), "gather kernel mismatch vs XLA"
+        t_pallas = timeit(gather_kernel, stacked, idx_a, idx_b)
+        t_xla = timeit(gather_xla, stacked, idx_a, idx_b)
+        words = Q * S * WORDS_PER_ROW
+        out["batched_gather_expr_count"] = {
+            "gwords_per_s": round(words / t_pallas / 1e9, 2),
+            "xla_gwords_per_s": round(words / t_xla / 1e9, 2),
+            "vs_xla": round(t_xla / t_pallas, 3),
+            "verified": True,
+        }
+    except Exception as e:
+        out["batched_gather_expr_count"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+    return out
+
+
+# --------------------------------------------- HBM-pressure / cache stanza
+
+
+def bench_scale():
+    """Leaf-cache eviction under an artificially tight byte budget
+    (SURVEY §7 hard part (a)): touch 2x the budget of distinct row planes
+    (cold, thrashing) then a working set that fits (warm), and report hit
+    rate / eviction counts / cold-vs-warm latency."""
+    from pilosa_tpu.constants import SHARD_WIDTH, WORDS_PER_ROW
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.parallel.engine import ShardedQueryEngine
+    from pilosa_tpu.pql.parser import parse
+
+    n_rows, n_shards = 192, 4
+    plane_bytes = n_shards * WORDS_PER_ROW * 4
+    budget = (n_rows // 2) * plane_bytes  # half the touched set fits
+
+    holder = Holder(None)
+    holder.open()
+    idx = holder.create_index("scale")
+    fld = idx.create_field("f")
+    rng = np.random.default_rng(9)
+    rows, cols = [], []
+    for row in range(n_rows):
+        for shard in range(n_shards):
+            c = rng.choice(SHARD_WIDTH, size=512, replace=False)
+            rows.append(np.full(512, row, dtype=np.uint64))
+            cols.append(c.astype(np.uint64) + np.uint64(shard * SHARD_WIDTH))
+    fld.import_bits(np.concatenate(rows), np.concatenate(cols))
+
+    old = os.environ.get("PILOSA_LEAF_CACHE_BYTES")
+    os.environ["PILOSA_LEAF_CACHE_BYTES"] = str(budget)
+    try:
+        engine = ShardedQueryEngine(holder)
+    finally:
+        if old is None:
+            os.environ.pop("PILOSA_LEAF_CACHE_BYTES", None)
+        else:
+            os.environ["PILOSA_LEAF_CACHE_BYTES"] = old
+    shards = list(range(n_shards))
+    calls = {r: parse(f"Row(f={r})").calls[0] for r in range(n_rows)}
+
+    # Cold sweep: every plane touched once, evicting under pressure.
+    t0 = time.perf_counter()
+    for r in range(n_rows):
+        engine.count("scale", calls[r], shards)
+    cold_s = time.perf_counter() - t0
+    cold_counters = dict(engine.counters)
+
+    # Warm working set: fits in budget, so the second pass must be all hits.
+    warm_rows = list(range(n_rows // 4))
+    for r in warm_rows:
+        engine.count("scale", calls[r], shards)  # populate
+    base = dict(engine.counters)
+    t0 = time.perf_counter()
+    for r in warm_rows:
+        engine.count("scale", calls[r], shards)
+    warm_s = time.perf_counter() - t0
+    warm_hits = engine.counters["leaf_hits"] - base["leaf_hits"]
+    warm_misses = engine.counters["leaf_misses"] - base["leaf_misses"]
+
+    holder.close()
+    return {
+        "budget_mib": round(budget / 2**20, 1),
+        "touched_mib": round(n_rows * plane_bytes / 2**20, 1),
+        "cold_ms_per_query": round(cold_s / n_rows * 1e3, 2),
+        "warm_ms_per_query": round(warm_s / len(warm_rows) * 1e3, 2),
+        "cold_evictions": cold_counters["leaf_evictions"],
+        "warm_hit_rate": round(warm_hits / max(warm_hits + warm_misses, 1), 3),
+    }
 
 
 def main():
@@ -152,10 +458,20 @@ def main():
     # collapsing duplicate queries while still counting them at full weight.
     iters = min(int(os.environ.get("BENCH_ITERS", "128")), n_rows)
 
-    platform = _ensure_live_backend()
+    platform, probes = _ensure_live_backend()
+    device = _device_info()
     holder, ex = build(n_shards, n_rows, density)
     count_qps, topn_qps = bench_device(ex, n_rows, n_shards, iters)
-    host_qps = bench_host(holder, n_rows, n_shards, iters)
+    host_qps, host_detail = bench_host(holder, n_rows, n_shards, iters)
+
+    pallas = (
+        bench_pallas() if os.environ.get("BENCH_PALLAS") != "0"
+        else {"skipped": "BENCH_PALLAS=0"}
+    )
+    scale = (
+        bench_scale() if os.environ.get("BENCH_SCALE") != "0"
+        else {"skipped": "BENCH_SCALE=0"}
+    )
 
     print(json.dumps({
         "metric": "count_intersect_qps_8shards",
@@ -165,11 +481,16 @@ def main():
         "detail": {
             "topn_qps": round(topn_qps, 2),
             "host_cpu_qps": round(host_qps, 2),
+            "host_baseline": host_detail,
             "shards": n_shards,
             "rows": n_rows,
             "iters": iters,
             "density": density,
-            "platform": platform,
+            "platform": device["platform"] if platform == "default" else platform,
+            "device": device,
+            "probes": probes,
+            "pallas": pallas,
+            "scale": scale,
         },
     }))
 

@@ -92,6 +92,16 @@ def unpack_bits(words: np.ndarray) -> Optional[np.ndarray]:
     return out[:n].astype(np.uint64)
 
 
+def and_count_words(a: np.ndarray, b: np.ndarray) -> Optional[int]:
+    """popcount(a & b) over packed uint32 planes (the host hot loop)."""
+    lib = load()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a, dtype=np.uint32)
+    b = np.ascontiguousarray(b, dtype=np.uint32)
+    return int(lib.and_count_words(a, b, min(len(a), len(b))))
+
+
 def intersection_count_u16(a: np.ndarray, b: np.ndarray) -> Optional[int]:
     lib = load()
     if lib is None:

@@ -184,6 +184,12 @@ class ShardedQueryEngine:
         # concurrency. One lock guards dict + byte-counter state; device work
         # (gather, device_put, jit) happens outside it.
         self._lock = threading.RLock()
+        # Observable cache behavior (hit rate / eviction pressure) for
+        # /debug/vars and the HBM-budget bench stanza.
+        self.counters = {
+            "leaf_hits": 0, "leaf_misses": 0, "leaf_evictions": 0,
+            "stack_hits": 0, "stack_misses": 0, "stack_evictions": 0,
+        }
 
     # ------------------------------------------------------------ caches
     #
@@ -245,7 +251,7 @@ class ShardedQueryEngine:
         return fn
 
     def _byte_cache_put(self, cache: Dict, key, entry: Tuple, budget: int,
-                        used: int) -> int:
+                        used: int, evict_counter: str = "") -> int:
         """Insert (fingerprint, array) at MRU and evict LRU entries past the
         byte budget; returns the updated used-bytes counter. Caller holds
         self._lock."""
@@ -259,6 +265,8 @@ class ShardedQueryEngine:
             if old_key == key:
                 break
             used -= cache.pop(old_key)[1].nbytes
+            if evict_counter:
+                self.counters[evict_counter] += 1
         return used
 
     @property
@@ -292,6 +300,7 @@ class ShardedQueryEngine:
                 cached = self._leaf_cache.get(key)
                 if cached is not None and cached[0] == fingerprint:
                     self._leaf_cache[key] = self._leaf_cache.pop(key)  # LRU touch
+                    self.counters["leaf_hits"] += 1
                     return cached[1]
             return None
 
@@ -305,9 +314,10 @@ class ShardedQueryEngine:
                     buf[i] = frag.plane_np(leaf.row)
             arr = jax.device_put(buf, shard_sharding(self.mesh, 2))
             with self._lock:
+                self.counters["leaf_misses"] += 1
                 self._leaf_bytes = self._byte_cache_put(
                     self._leaf_cache, key, (fingerprint, arr),
-                    self._leaf_budget, self._leaf_bytes,
+                    self._leaf_budget, self._leaf_bytes, "leaf_evictions",
                 )
         finally:
             self._release(("leaf", key))
@@ -343,6 +353,7 @@ class ShardedQueryEngine:
                 cached = self._stack_cache.get(key)
                 if cached is not None and cached[0] == fp:
                     self._stack_cache[key] = self._stack_cache.pop(key)  # LRU touch
+                    self.counters["stack_hits"] += 1
                     return cached[1]
             return None
 
@@ -363,9 +374,10 @@ class ShardedQueryEngine:
                 stack_jit = self._stack_jit
             stacked = stack_jit(tuple(arrs))
             with self._lock:
+                self.counters["stack_misses"] += 1
                 self._stack_bytes = self._byte_cache_put(
                     self._stack_cache, key, (fp, stacked),
-                    self._stack_budget, self._stack_bytes,
+                    self._stack_budget, self._stack_bytes, "stack_evictions",
                 )
         finally:
             self._release(("stack", key))

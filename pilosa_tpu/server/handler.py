@@ -407,11 +407,18 @@ class Handler:
 
     def handle_debug_vars(self, **kw):
         """expvar equivalent (reference mounts /debug/vars,
-        http/handler.go:196): stats counters/gauges/timings as JSON."""
+        http/handler.go:196): stats counters/gauges/timings as JSON, plus
+        the device engine's cache hit/eviction counters."""
         stats = self.api.server.stats
-        if hasattr(stats, "snapshot"):
-            return stats.snapshot()
-        return {}
+        out = stats.snapshot() if hasattr(stats, "snapshot") else {}
+        # Peek the lazy slot, NOT the .engine property: a stats scrape must
+        # never be the thing that first initializes the device backend (a
+        # dead TPU tunnel would hang the endpoint).
+        engine = getattr(getattr(self.api, "executor", None), "_engine", None)
+        if engine is not None:
+            out = dict(out)
+            out["engine_cache"] = dict(engine.counters)
+        return out
 
     _profile_lock = threading.Lock()
 
