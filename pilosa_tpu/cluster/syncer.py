@@ -74,7 +74,8 @@ class HolderSyncer:
         remote_blocks: List[Tuple[object, Dict[int, bytes]]] = []
         for node in replicas:
             try:
-                blocks = self.client.fragment_blocks(node, index, field, shard)
+                blocks = self.client.fragment_blocks(node, index, field, shard,
+                                                     view=view)
                 remote_blocks.append(
                     (node, {b["id"]: bytes.fromhex(b["checksum"]) for b in blocks})
                 )
@@ -106,10 +107,21 @@ class HolderSyncer:
         if not datas:
             return
         sets, clears = frag.merge_block(block_id, datas)
-        # Push per-replica diffs as Set/Clear PQL (fragment.go:1814-1903).
         base = shard * SHARD_WIDTH
         for node, add, rem in zip(nodes, sets, clears):
-            calls = [f"Set({base + c}, {field}={r})" for r, c in add]
-            calls += [f"Clear({base + c}, {field}={r})" for r, c in rem]
-            if calls:
+            if not add and not rem:
+                continue
+            if view == VIEW_STANDARD:
+                # Push standard-view diffs as Set/Clear PQL
+                # (fragment.go:1814-1903 — the reference only syncs this view).
+                calls = [f"Set({base + c}, {field}={r})" for r, c in add]
+                calls += [f"Clear({base + c}, {field}={r})" for r, c in rem]
                 self.client.query_node(node, index, " ".join(calls), remote=True)
+            else:
+                # Time/bsig views are unreachable via PQL writes; apply the
+                # diff through the view-addressed internal endpoint instead.
+                self.client.send_block_diff(
+                    node, index, field, view, shard, block_id,
+                    [[int(r), int(base + c)] for r, c in add],
+                    [[int(r), int(base + c)] for r, c in rem],
+                )

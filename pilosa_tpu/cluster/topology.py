@@ -20,6 +20,11 @@ class Topology:
     def __init__(self, path: Optional[str]):
         self.path = path
         self.node_ids: List[str] = []
+        # Full node records (id + uri) so a restarting coordinator can dial
+        # prior members to solicit rejoins instead of wedging in STARTING
+        # (the reference recovers via memberlist re-join events,
+        # cluster.go:1615 nodeJoin; without gossip we must dial out).
+        self.nodes: List[Node] = []
 
     @classmethod
     def load(cls, path: Optional[str]) -> "Topology":
@@ -28,16 +33,24 @@ class Topology:
             with open(path) as f:
                 data = json.load(f)
             t.node_ids = data.get("nodeIDs", [])
+            t.nodes = [Node.from_dict(n) for n in data.get("nodes", [])]
         return t
 
     def save(self, nodes: List[Node]) -> None:
         self.node_ids = [n.id for n in nodes]
+        self.nodes = list(nodes)
         if not self.path:
             return
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"nodeIDs": self.node_ids}, f)
+            json.dump(
+                {
+                    "nodeIDs": self.node_ids,
+                    "nodes": [n.to_dict() for n in nodes],
+                },
+                f,
+            )
         os.replace(tmp, self.path)
 
     def contains_id(self, node_id: str) -> bool:

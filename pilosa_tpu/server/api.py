@@ -301,8 +301,11 @@ class API:
             "state": self.cluster.state,
             "nodes": [n.to_dict() for n in self.cluster.nodes],
             "localID": self.cluster.node.id,
-            # NodeStatus payload (reference gossip.go:240-273 push/pull sync).
+            # NodeStatus payload (reference gossip.go:240-273 push/pull sync):
+            # schema + max shards ride the probe so peers converge without a
+            # dedicated gossip plane.
             "maxShards": self.shards_max(),
+            "schema": self.holder.schema(),
         }
 
     def info(self) -> dict:
@@ -311,13 +314,32 @@ class API:
     def shards_max(self) -> Dict[str, int]:
         return {name: idx.max_shard() for name, idx in self.holder.indexes.items()}
 
-    def fragment_blocks(self, index: str, field: str, shard: int) -> List[dict]:
-        frag = self.holder.fragment(index, field, "standard", shard)
+    def fragment_blocks(self, index: str, field: str, shard: int,
+                        view: str = "standard") -> List[dict]:
+        frag = self.holder.fragment(index, field, view, shard)
         if frag is None:
             from ..errors import FragmentNotFoundError
 
-            raise FragmentNotFoundError(f"{index}/{field}/{shard}")
+            raise FragmentNotFoundError(f"{index}/{field}/{view}/{shard}")
         return [b.to_dict() for b in frag.blocks()]
+
+    def apply_block_diff(self, index: str, field: str, view: str, shard: int,
+                         sets, clears) -> None:
+        """View-exact anti-entropy write-back: apply consensus Set/Clear
+        pairs to the addressed view (columns are global ids). Creates the
+        view/fragment if the replica is missing them, like the reference
+        syncer does locally (holder.go:751-762)."""
+        fld = self.holder.field(index, field)
+        if fld is None:
+            from ..errors import FieldNotFoundError
+
+            raise FieldNotFoundError(f"{index}/{field}")
+        v = fld.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(shard, broadcast=False)
+        for row, col in sets:
+            frag.set_bit(int(row), int(col))
+        for row, col in clears:
+            frag.clear_bit(int(row), int(col))
 
     def fragment_block_data(self, index: str, field: str, view: str, shard: int, block: int) -> dict:
         frag = self.holder.fragment(index, field, view, shard)

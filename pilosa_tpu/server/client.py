@@ -20,7 +20,9 @@ from .handler import deserialize_remote
 
 
 class ClientError(PilosaError):
-    pass
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
 
 
 def _node_url(node) -> str:
@@ -57,7 +59,7 @@ class InternalClient:
                 return resp.read()
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
-            raise ClientError(f"{method} {url}: {e.code} {detail}") from e
+            raise ClientError(f"{method} {url}: {e.code} {detail}", status=e.code) from e
         except urllib.error.URLError as e:
             raise ClientError(f"{method} {url}: {e.reason}") from e
 
@@ -232,15 +234,41 @@ class InternalClient:
         url = f"{_node_url(host)}/internal/fragment/nodes?index={index}&shard={shard}"
         return json.loads(self._request("GET", url))
 
-    def fragment_blocks(self, node, index: str, field: str, shard: int) -> List[dict]:
+    def fragment_blocks(self, node, index: str, field: str, shard: int,
+                        view: str = "standard") -> List[dict]:
+        # The reference RPC is view-blind (http/handler.go:1058 hardcodes
+        # standard); carrying the view avoids cross-view checksum
+        # comparisons when the syncer walks time/bsig views.
         url = (f"{_node_url(node)}/internal/fragment/blocks?"
-               f"index={index}&field={field}&shard={shard}")
-        return json.loads(self._request("GET", url))["blocks"]
+               f"index={index}&field={field}&view={view}&shard={shard}")
+        try:
+            return json.loads(self._request("GET", url))["blocks"]
+        except ClientError as e:
+            if e.status == 404:
+                # Replica doesn't have the fragment yet: empty block set, so
+                # the syncer pushes everything (client.go:666-668).
+                return []
+            raise
+
+    def send_block_diff(self, node, index: str, field: str, view: str, shard: int,
+                        block: int, sets, clears) -> None:
+        """Apply a merged block diff to a replica's exact view. Set/Clear
+        PQL (the reference's push, fragment.go:1814-1903) can only reach the
+        standard view; non-standard views need a view-addressed write."""
+        url = (f"{_node_url(node)}/internal/fragment/block/data?"
+               f"index={index}&field={field}&view={view}&shard={shard}&block={block}")
+        body = json.dumps({"sets": sets, "clears": clears}).encode()
+        self._request("POST", url, body)
 
     def block_data(self, node, index: str, field: str, view: str, shard: int, block: int) -> dict:
         url = (f"{_node_url(node)}/internal/fragment/block/data?"
                f"index={index}&field={field}&view={view}&shard={shard}&block={block}")
-        return json.loads(self._request("GET", url))
+        try:
+            return json.loads(self._request("GET", url))
+        except ClientError as e:
+            if e.status == 404:
+                return {"rowIDs": [], "columnIDs": []}
+            raise
 
     def retrieve_shard_from_uri(self, uri: str, index: str, field: str, view: str, shard: int) -> bytes:
         url = (f"{_node_url(uri)}/internal/fragment/data?"

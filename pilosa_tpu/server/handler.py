@@ -108,6 +108,7 @@ class Handler:
             Route("POST", r"/internal/cluster/message", self.handle_cluster_message),
             Route("GET", r"/internal/fragment/blocks", self.handle_fragment_blocks),
             Route("GET", r"/internal/fragment/block/data", self.handle_fragment_block_data),
+            Route("POST", r"/internal/fragment/block/data", self.handle_post_block_data),
             Route("GET", r"/internal/fragment/nodes", self.handle_fragment_nodes),
             Route("GET", r"/internal/fragment/data", self.handle_fragment_data),
             Route("POST", r"/internal/fragment/data", self.handle_post_fragment_data),
@@ -141,7 +142,13 @@ class Handler:
                     return result
                 return 200, "application/json", json.dumps(result).encode()
             except PilosaError as e:
-                return 400, "application/json", json.dumps({"error": str(e)}).encode()
+                from ..errors import FragmentNotFoundError
+
+                # Missing fragments map to 404 so the anti-entropy client can
+                # treat the replica as empty instead of failing the sync
+                # (reference http/handler.go:776,984,1030).
+                status = 404 if isinstance(e, FragmentNotFoundError) else 400
+                return status, "application/json", json.dumps({"error": str(e)}).encode()
             except Exception as e:  # pragma: no cover - defensive
                 if self.logger:
                     self.logger.error("handler error: %s", traceback.format_exc())
@@ -354,9 +361,13 @@ class Handler:
         return {}
 
     def handle_fragment_blocks(self, query, **kw):
+        # view is optional for reference parity (its RPC has no view param);
+        # absent means standard.
+        view = query.get("view", ["standard"])[0]
         return {
             "blocks": self.api.fragment_blocks(
-                query["index"][0], query["field"][0], int(query["shard"][0])
+                query["index"][0], query["field"][0], int(query["shard"][0]),
+                view=view,
             )
         }
 
@@ -365,6 +376,15 @@ class Handler:
             query["index"][0], query["field"][0], query["view"][0],
             int(query["shard"][0]), int(query["block"][0]),
         )
+
+    def handle_post_block_data(self, query, body, **kw):
+        data = _json_body(body)
+        self.api.apply_block_diff(
+            query["index"][0], query["field"][0], query["view"][0],
+            int(query["shard"][0]),
+            data.get("sets", []), data.get("clears", []),
+        )
+        return {}
 
     def handle_fragment_nodes(self, query, **kw):
         index = query["index"][0]

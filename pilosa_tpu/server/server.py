@@ -133,6 +133,7 @@ class Server:
         self.resize_coordinator = None  # set on demand by coordinators
         self._httpd = None
         self._http_thread = None
+        self._join_lock = threading.Lock()  # admission may race solicit vs HTTP
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self.opened = False
@@ -218,6 +219,14 @@ class Server:
                 "cluster STARTING: waiting for topology quorum, pending nodes: %s",
                 pending,
             )
+            # Actively solicit prior members: if only the coordinator
+            # restarted, the healthy peers have no reason to re-send
+            # node-join (they only do so from their own open()), so a
+            # passive wait wedges the cluster in STARTING forever. Probing
+            # each persisted member and treating a live /status as a rejoin
+            # is our stand-in for the reference's memberlist re-join events
+            # (cluster.go:1615 nodeJoin via gossip).
+            self._spawn(self._solicit_topology_members, 0.5)
         else:
             self.cluster.state = STATE_NORMAL
 
@@ -231,7 +240,12 @@ class Server:
             self._spawn(self._monitor_translate_replication, 1.0)
         if self.diagnostics.interval > 0:
             self._spawn(self._monitor_diagnostics, self.diagnostics.interval)
-        if self.member_monitor_interval > 0 and len(self.cluster.nodes) > 1:
+        if self.member_monitor_interval > 0 and (
+            len(self.cluster.nodes) > 1 or self.join_addr
+        ):
+            # Joiners start with only themselves in the node list; the
+            # monitor must still run so they pick up peer schema and
+            # max-shard state after admission.
             self._spawn(self._monitor_members, self.member_monitor_interval)
         if self.cluster.state == STATE_NORMAL:
             # While STARTING on topology quorum the persisted node list is
@@ -286,6 +300,29 @@ class Server:
             time.sleep(0.05)
         raise PilosaError(f"timed out joining cluster via {self.join_addr}")
 
+    def _solicit_topology_members(self) -> None:
+        """While STARTING on topology quorum, probe each persisted prior
+        member; a live /status is treated as a rejoin. Covers the
+        only-the-coordinator-restarted case where no peer will ever re-send
+        node-join on its own (see ADVICE r2; reference analog is memberlist
+        gossip re-join, cluster.go:1615)."""
+        if self.cluster.state != STATE_STARTING:
+            return
+        for node in list(self.topology.nodes):
+            if self.cluster.state != STATE_STARTING:
+                return
+            if node.id == self.node.id or self.cluster.node_by_id(node.id):
+                continue
+            try:
+                self._probe_client.status(node.uri)
+            except PilosaError:
+                continue
+            # Re-admit with the coordinator flag cleared: this node is the
+            # acting coordinator now, whatever the checkpoint says.
+            rejoined = Node(id=node.id, uri=node.uri)
+            self.logger.info("soliciting prior member %s: alive, rejoining", node.id)
+            self.handle_node_join(rejoined)
+
     def handle_node_join(self, node: Node) -> None:
         """Coordinator-side admission (cluster.go:1638 nodeJoin)."""
         if not self.node.is_coordinator:
@@ -296,6 +333,10 @@ class Server:
                 coordinator, {"type": "node-join", "node": node.to_dict()}
             )
             return
+        with self._join_lock:
+            self._admit_node(node)
+
+    def _admit_node(self, node: Node) -> None:
         if self.cluster.node_by_id(node.id) is not None:
             # Already a member: re-send the cluster status (idempotent join).
             self.client.send_message(node, self._status_message())
@@ -319,9 +360,24 @@ class Server:
             # broadcasting partial membership would make peers overwrite
             # their persisted topology with an incomplete node list.
             self.client.send_message(node, self._status_message())
+            self._send_schema(node)
             return
         new_nodes = sorted(self.cluster.nodes + [node], key=lambda n: n.id)
         self._retopologize(new_nodes, extra_recipients=[node])
+        self._send_schema(node)
+
+    def _send_schema(self, node: Node) -> None:
+        """Push the local schema to a (re)joining node so it converges
+        immediately rather than waiting for its next member-monitor probe
+        (reference applies schema via gossip NodeStatus merge,
+        gossip/gossip.go:240-273 MergeRemoteState)."""
+        schema = self.holder.schema()
+        if not schema:
+            return
+        try:
+            self.client.send_message(node, {"type": "schema", "schema": schema})
+        except ClientError as e:
+            self.logger.error("schema push to %s failed: %s", node.id, e)
 
     def handle_node_leave(self, node_id: str) -> None:
         """Coordinator-side removal (api.go:777 RemoveNode): shards the
@@ -456,11 +512,38 @@ class Server:
                 if node.id in self.cluster.unavailable:
                     self.logger.info("node %s recovered", node.id)
                 self.cluster.mark_available(node.id)
-                # Merge the peer's max-shard view (gossip push/pull sync).
+                # Merge the peer's NodeStatus (gossip push/pull sync,
+                # gossip/gossip.go:240-273): schema first — a node that was
+                # down during a create-field broadcast converges here — then
+                # max shards. apply_schema is create-if-not-exists, so the
+                # merge is a monotonic union exactly like the reference's
+                # MergeRemoteState.
+                schema = status.get("schema")
+                if schema:
+                    try:
+                        self.holder.apply_schema(schema)
+                    except PilosaError as e:
+                        self.logger.error(
+                            "schema merge from %s failed: %s", node.id, e
+                        )
                 for index_name, max_shard in status.get("maxShards", {}).items():
                     idx = self.holder.index(index_name)
                     if idx is not None:
                         idx.set_remote_max_shard(max_shard)
+                # A probed peer reporting STARTING without us in its node
+                # list is a restarted coordinator waiting on topology
+                # quorum: re-send node-join so it can count us (the
+                # reference gets this for free from memberlist join events).
+                if status.get("state") == STATE_STARTING and not any(
+                    n.get("id") == self.node.id for n in status.get("nodes", [])
+                ):
+                    try:
+                        self.client.send_message(
+                            node,
+                            {"type": "node-join", "node": self.node.to_dict()},
+                        )
+                    except ClientError:
+                        pass
 
     def _monitor_translate_replication(self) -> None:
         data = self.client.translate_data(

@@ -124,6 +124,61 @@ def test_anti_entropy_repairs_divergence(cluster2r):
     assert frag0.bit(1, 99)
 
 
+def test_anti_entropy_syncs_nonstandard_views(cluster2r):
+    """Divergent bsig and time-quantum views converge: the blocks RPC is
+    view-addressed (the reference's is standard-only, http/handler.go:1058)
+    and non-standard diffs are pushed via the view-exact block endpoint
+    since Set/Clear PQL can only reach the standard view."""
+    client = InternalClient()
+    h0 = f"localhost:{cluster2r[0].port}"
+    client.create_index(h0, "vw")
+    client.create_field(h0, "vw", "t", {"type": "time", "timeQuantum": "YMD"})
+    time.sleep(0.05)
+    client.query(h0, "vw", "Set(5, t=1, 2018-01-02T00:00)")
+
+    # Diverge a time view: plant a raw bit in node0's fragment only.
+    tview = next(n for n in cluster2r[0].holder.field("vw", "t").view_names()
+                 if n.startswith("standard_"))
+    tf0 = cluster2r[0].holder.fragment("vw", "t", tview, 0)
+    tf0.set_bit(1, 42)
+    tf1 = cluster2r[1].holder.fragment("vw", "t", tview, 0)
+    assert not tf1.bit(1, 42)
+
+    # A whole view the replica has never heard of must also converge.
+    bview = cluster2r[0].holder.field("vw", "t").create_view_if_not_exists("bsig_t")
+    bfrag = bview.create_fragment_if_not_exists(0, broadcast=False)
+    bfrag.set_bit(2, 99)
+    assert cluster2r[1].holder.fragment("vw", "t", "bsig_t", 0) is None
+
+    HolderSyncer(cluster2r[0]).sync_holder()
+    assert tf1.bit(1, 42)
+    bfrag1 = cluster2r[1].holder.fragment("vw", "t", "bsig_t", 0)
+    assert bfrag1 is not None and bfrag1.bit(2, 99)
+    # The replicated time bit survived the sweep on both nodes.
+    assert client.query(h0, "vw", "Count(Row(t=1))")["results"][0] == 1
+
+
+def test_anti_entropy_creates_missing_replica_fragment(cluster2r):
+    """A replica that never saw a fragment receives it via anti-entropy:
+    remote 404 on the blocks RPC counts as an empty block set so diffs are
+    pushed (client.go:666-668 ErrFragmentNotFound -> empty)."""
+    client = InternalClient()
+    h0 = f"localhost:{cluster2r[0].port}"
+    client.create_index(h0, "mf")
+    client.create_field(h0, "mf", "f")
+    time.sleep(0.05)
+    # Create the fragment only on node0, bypassing replication.
+    fld0 = cluster2r[0].holder.field("mf", "f")
+    view0 = fld0.create_view_if_not_exists("standard")
+    frag0 = view0.create_fragment_if_not_exists(0, broadcast=False)
+    frag0.set_bit(3, 17)
+    assert cluster2r[1].holder.fragment("mf", "f", "standard", 0) is None
+
+    HolderSyncer(cluster2r[0]).sync_holder()
+    frag1 = cluster2r[1].holder.fragment("mf", "f", "standard", 0)
+    assert frag1 is not None and frag1.bit(3, 17)
+
+
 def test_anti_entropy_attr_sync(cluster2r):
     client = InternalClient()
     h0 = f"localhost:{cluster2r[0].port}"

@@ -172,6 +172,66 @@ def test_coordinator_startup_quorum(tmp_path):
         s0.close()
 
 
+def test_coordinator_restart_recovers_without_peer_restart(tmp_path):
+    """Only the coordinator restarts: it must solicit the still-healthy
+    peer back into the cluster instead of wedging in STARTING until every
+    peer process is also bounced (ADVICE r2 medium; the reference recovers
+    via memberlist gossip re-join events, cluster.go:1615 nodeJoin)."""
+    port0, port1 = free_port(), free_port()
+    s0 = make_server(tmp_path, "n0", port0)
+    client = InternalClient()
+    client.create_index(s0.node.uri, "cr")
+    client.create_field(s0.node.uri, "cr", "f")
+    client.query(s0.node.uri, "cr", "Set(1, f=1)")
+    s1 = make_server(tmp_path, "n1", port1, join_addr=s0.node.uri)
+    assert wait_for(lambda: len(s0.cluster.nodes) == 2 and s0.cluster.state == "NORMAL")
+    s0.close()
+
+    # s1 keeps running; the restarted coordinator comes up STARTING and
+    # must discover s1 on its own.
+    s0 = make_server(tmp_path, "n0", port0)
+    try:
+        assert wait_for(lambda: s0.cluster.state == "NORMAL", timeout=15)
+        assert {n.id for n in s0.cluster.nodes} == {s0.node.id, s1.node.id}
+        assert s0.api.query("cr", "Count(Row(f=1))")
+    finally:
+        s1.close()
+        s0.close()
+
+
+def test_schema_converges_after_missed_broadcast(tmp_path):
+    """A node that was down during create-field converges via the member
+    monitor's NodeStatus schema merge after it comes back, without a restart
+    of anything else (reference gossip push/pull sync, gossip.go:240-273)."""
+    ports = [free_port(), free_port()]
+    hosts = [f"localhost:{p}" for p in ports]
+    s0 = make_server(tmp_path, "n0", ports[0], cluster_hosts=hosts,
+                     member_monitor_interval=0.2)
+    s1 = make_server(tmp_path, "n1", ports[1], cluster_hosts=hosts,
+                     is_coordinator=False, member_monitor_interval=0.2)
+    client = InternalClient()
+    try:
+        client.create_index(s0.node.uri, "sc")
+        assert wait_for(lambda: s1.holder.index("sc") is not None)
+        s1.close()
+
+        # s1 is down: the create-field broadcast never reaches it.
+        client.create_field(s0.node.uri, "sc", "missed")
+
+        s1 = make_server(tmp_path, "n1", ports[1], cluster_hosts=hosts,
+                         is_coordinator=False, member_monitor_interval=0.2)
+        # No broadcast is replayed — only the monitor's schema pull can
+        # deliver the field.
+        assert wait_for(lambda: s1.holder.field("sc", "missed") is not None)
+        client.query(s0.node.uri, "sc", "Set(1, missed=1)")
+        assert client.query(
+            s1.node.uri, "sc", "Count(Row(missed=1))"
+        )["results"][0] == 1
+    finally:
+        s1.close()
+        s0.close()
+
+
 def test_startup_quorum_refuses_unknown_host(tmp_path):
     port0 = free_port()
     s0 = make_server(tmp_path, "n0", port0)
