@@ -449,6 +449,53 @@ def bench_scale():
     }
 
 
+# ------------------------------------------------------- open-time stanza
+
+
+def bench_open():
+    """Fragment open cost on a sizable on-disk file: the shipped lazy mmap
+    parse (Bitmap.from_buffer copy=False; open is O(container headers))
+    vs the eager full parse it replaced (every payload copied at open)."""
+    import tempfile
+
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.storage.bitmap import Bitmap
+
+    rng = np.random.default_rng(3)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "frag.0")
+        f = Fragment(path, "i", "f", "standard", 0)
+        f.open()
+        n_rows, bits_per_row = 64, 160_000  # dense bitset containers
+        rows = np.repeat(np.arange(n_rows, dtype=np.uint64), bits_per_row)
+        cols = rng.integers(0, SHARD_WIDTH, rows.size, dtype=np.uint64)
+        f.bulk_import(rows, cols)
+        f.close()
+        size_mib = os.path.getsize(path) / 2**20
+
+        t0 = time.perf_counter()
+        f2 = Fragment(path, "i", "f", "standard", 0)
+        f2.open()
+        lazy_ms = (time.perf_counter() - t0) * 1e3
+        # Prove the lazy open still serves reads.
+        count = f2.row_count(1)
+        f2.close()
+        assert count > 0
+
+        with open(path, "rb") as fh:
+            data = fh.read()
+        t0 = time.perf_counter()
+        Bitmap.from_bytes(data)
+        eager_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "file_mib": round(size_mib, 1),
+        "lazy_open_ms": round(lazy_ms, 2),
+        "eager_parse_ms": round(eager_ms, 2),
+        "speedup": round(eager_ms / max(lazy_ms, 1e-6), 1),
+    }
+
+
 def main():
     n_shards = int(os.environ.get("BENCH_SHARDS", "8"))
     n_rows = int(os.environ.get("BENCH_ROWS", "128"))
@@ -472,6 +519,10 @@ def main():
         bench_scale() if os.environ.get("BENCH_SCALE") != "0"
         else {"skipped": "BENCH_SCALE=0"}
     )
+    open_stanza = (
+        bench_open() if os.environ.get("BENCH_OPEN") != "0"
+        else {"skipped": "BENCH_OPEN=0"}
+    )
 
     print(json.dumps({
         "metric": "count_intersect_qps_8shards",
@@ -491,6 +542,7 @@ def main():
             "probes": probes,
             "pallas": pallas,
             "scale": scale,
+            "open": open_stanza,
         },
     }))
 

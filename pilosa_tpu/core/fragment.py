@@ -119,10 +119,18 @@ class Fragment:
 
     def open(self) -> None:
         if self.path and os.path.exists(self.path):
-            with open(self.path, "rb") as f:
-                data = f.read()
-            if data:
-                self.storage = Bitmap.from_bytes(data)
+            size = os.path.getsize(self.path)
+            if size:
+                # mmap + zero-copy parse (the reference mmaps too,
+                # fragment.go:167-224): open cost is O(container headers),
+                # payloads are paged in on first touch, and host RAM is not
+                # double-buffered. Mutations copy-on-write; snapshot()
+                # replaces the inode so live views stay valid.
+                import mmap
+
+                with open(self.path, "rb") as f:
+                    mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+                self.storage = Bitmap.from_buffer(mm, copy=False)
                 self.op_n = self.storage.op_n
         if self.path:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
@@ -467,6 +475,12 @@ class Fragment:
             pos = np.asarray(rows, dtype=np.uint64) * np.uint64(SHARD_WIDTH) + np.asarray(
                 cols, dtype=np.uint64
             ) - base_pos
+            # Drop replica pairs outside this block: below-block positions
+            # wrap uint64 to huge values and above-block ones exceed the
+            # width, so a single bound check rejects both. Without it,
+            # wrapped garbage can reach consensus and persist phantom rows
+            # at arbitrary local bit positions.
+            pos = pos[pos < np.uint64(block_width)]
             positions.append(np.unique(pos))
         # Even splits keep the bit (reference fragment.go:1218 majorityN =
         # (n+1)/2 with setN >= majorityN).

@@ -632,18 +632,46 @@ class Executor:
     # --------------------------------------------------------------- writes
 
     def _for_shard_owners(self, index: str, c: Call, shard: int, opt: ExecOptions, local_fn):
-        """Apply a write locally and forward to other owners (executor.go:1109)."""
+        """Apply a write locally and forward to other owners (executor.go:1109).
+
+        Replica failures are tolerated like the read path's mapper retry:
+        dead owners are marked unavailable and skipped, and the write
+        succeeds as long as at least one owner applied it — anti-entropy
+        repairs the lagging replica when it returns. Only if EVERY owner is
+        unreachable does the write raise."""
+        from .server.client import ClientError
+
         ret = False
+        applied = 0
+        errors = []
         for node in self.cluster.shard_nodes(index, shard):
             if node.id == self.node.id:
                 if local_fn():
                     ret = True
+                applied += 1
                 continue
             if opt.remote:
+                applied += 1  # forwarding node already counted the write
                 continue
-            res = self.client.query_node(node, index, str(c), remote=True)
+            if node.id in self.cluster.unavailable:
+                # Known-dead replica: don't pay a connect timeout per write.
+                errors.append(f"{node.id}: unavailable")
+                continue
+            try:
+                res = self.client.query_node(node, index, str(c), remote=True)
+            except ClientError as e:
+                self.cluster.mark_unavailable(node.id)
+                self.holder.stats.count("WriteForwardFailed", 1)
+                errors.append(f"{node.id}: {e}")
+                continue
+            applied += 1
             if res and isinstance(res[0], bool):
                 ret = ret or res[0]
+        if applied == 0:
+            raise QueryError(
+                f"write failed on all owners of {index}/shard {shard}: "
+                + "; ".join(errors)
+            )
         return ret
 
     def _execute_set_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
@@ -728,12 +756,25 @@ class Executor:
         self._forward_to_all(index, c, opt)
 
     def _forward_to_all(self, index: str, c: Call, opt: ExecOptions) -> None:
+        """Fan a write out to every node. The local apply already succeeded,
+        so dead peers are marked unavailable and skipped rather than failing
+        the request (anti-entropy converges them later); previously one dead
+        peer made every attr/value write block on a client timeout and raise."""
+        from .server.client import ClientError
+
         if opt.remote:
             return
         for node in self.cluster.nodes:
             if node.id == self.node.id:
                 continue
-            self.client.query_node(node, index, str(c), remote=True)
+            if node.id in self.cluster.unavailable:
+                self.holder.stats.count("WriteForwardSkipped", 1)
+                continue
+            try:
+                self.client.query_node(node, index, str(c), remote=True)
+            except ClientError:
+                self.cluster.mark_unavailable(node.id)
+                self.holder.stats.count("WriteForwardFailed", 1)
 
     # ---------------------------------------------------------- translation
 

@@ -131,6 +131,14 @@ class Container:
             self.arr = _words_to_arr(self.bits)
             self.bits = None
 
+    def _mutable_bits(self) -> np.ndarray:
+        """Copy-on-write: bitset payloads parsed zero-copy from an mmap (or
+        bytes) are read-only views; the first in-place mutation promotes
+        them to a private copy."""
+        if not self.bits.flags.writeable:
+            self.bits = self.bits.copy()
+        return self.bits
+
     # ------------------------------------------------------------ point ops
 
     def add(self, low: int) -> bool:
@@ -138,7 +146,7 @@ class Container:
             w, b = low >> 6, np.uint64(low & 63)
             if (self.bits[w] >> b) & _WORD_ONE:
                 return False
-            self.bits[w] |= _WORD_ONE << b
+            self._mutable_bits()[w] |= _WORD_ONE << b
             self.n += 1
             return True
         c = self.arr
@@ -155,7 +163,7 @@ class Container:
             w, b = low >> 6, np.uint64(low & 63)
             if not (self.bits[w] >> b) & _WORD_ONE:
                 return False
-            self.bits[w] &= ~(_WORD_ONE << b)
+            self._mutable_bits()[w] &= ~(_WORD_ONE << b)
             self.n -= 1
             self._maybe_sparsify()
             return True
@@ -180,8 +188,9 @@ class Container:
         if self.bits is None and self.n + len(chunk) > ARRAY_MAX_SIZE:
             self._force_densify()
         if self.bits is not None:
-            self.bits |= _arr_to_words(chunk)
-            self.n = _popcount(self.bits)
+            bits = self._mutable_bits()
+            bits |= _arr_to_words(chunk)
+            self.n = _popcount(bits)
         else:
             self.arr = np.union1d(self.arr, chunk)
             self.n = len(self.arr)
@@ -189,8 +198,9 @@ class Container:
 
     def remove_sorted(self, chunk: np.ndarray) -> None:
         if self.bits is not None:
-            self.bits &= ~_arr_to_words(chunk)
-            self.n = _popcount(self.bits)
+            bits = self._mutable_bits()
+            bits &= ~_arr_to_words(chunk)
+            self.n = _popcount(bits)
             self._maybe_sparsify()
         else:
             self.arr = np.setdiff1d(self.arr, chunk, assume_unique=True)
@@ -700,6 +710,15 @@ class Bitmap:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Bitmap":
+        return cls.from_buffer(data, copy=True)
+
+    @classmethod
+    def from_buffer(cls, data, copy: bool = True) -> "Bitmap":
+        """Parse a roaring buffer. With copy=False, array/bitset payloads
+        stay zero-copy read-only views into `data` (an mmap, typically):
+        open cost is O(headers), untouched containers are never paged in,
+        and the first mutation of a bitset promotes it via copy-on-write
+        (Container._mutable_bits). The views keep `data` alive."""
         b = cls()
         if len(data) < HEADER_BASE_SIZE:
             raise ValueError("data too small")
@@ -724,18 +743,24 @@ class Bitmap:
             if off >= len(data):
                 raise ValueError(f"offset out of bounds: off={off}, len={len(data)}")
             if typ == CONTAINER_ARRAY:
-                arr = np.frombuffer(data, dtype="<u2", count=n, offset=off).astype(np.uint16)
+                arr = np.frombuffer(data, dtype="<u2", count=n, offset=off)
+                if copy:
+                    arr = arr.astype(np.uint16)
                 c = Container(arr=arr, n=n)
                 ops_offset = max(ops_offset, off + 2 * n)
             elif typ == CONTAINER_BITMAP:
-                words = np.frombuffer(data, dtype="<u8", count=BITMAP_N, offset=off).astype(
-                    np.uint64
-                )
+                words = np.frombuffer(data, dtype="<u8", count=BITMAP_N, offset=off)
                 # Dense containers stay bitsets — no value-list round trip.
-                # Cardinality is derived from the payload, not the header, so
-                # a corrupt/foreign n field cannot poison count math.
-                c = Container(bits=words)
-                n = c.n
+                # In copy mode cardinality is derived from the payload so a
+                # corrupt/foreign n field cannot poison count math; in lazy
+                # mode recounting would page in every dense container, so
+                # the header n is trusted (as the reference reader does,
+                # roaring.go UnmarshalBinary) and `check()` still validates.
+                if copy:
+                    c = Container(bits=words.astype(np.uint64))
+                    n = c.n
+                else:
+                    c = Container(bits=words, n=n)
                 ops_offset = max(ops_offset, off + 8 * BITMAP_N)
             elif typ == CONTAINER_RUN:
                 run_n = struct.unpack_from("<H", data, off)[0]

@@ -28,6 +28,7 @@ import os
 import struct
 import threading
 from array import array
+from hashlib import blake2b as _blake2b
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -46,13 +47,20 @@ class _OffsetTable:
         self.slots = np.full(capacity, -1, dtype=np.int64)
         self.n = 0
 
+    @staticmethod
+    def _hash(full_key: bytes) -> int:
+        # Deterministic across processes (unlike PYTHONHASHSEED-randomized
+        # hash(bytes)) so probe distribution and rebuild cost are
+        # reproducible; blake2b is C-speed for the short keys involved.
+        return int.from_bytes(_blake2b(full_key, digest_size=8).digest(), "little")
+
     def _idx(self, h: int) -> int:
         return h % len(self.slots)
 
     def get(self, full_key: bytes, read_key) -> int:
         """Offset for full_key, or -1."""
         slots = self.slots
-        i = self._idx(hash(full_key))
+        i = self._idx(self._hash(full_key))
         for _ in range(len(slots)):
             off = slots[i]
             if off < 0:
@@ -66,7 +74,7 @@ class _OffsetTable:
         if (self.n + 1) * 10 > len(self.slots) * 7:  # load factor 0.7
             self._grow(read_key)
         slots = self.slots
-        i = self._idx(hash(full_key))
+        i = self._idx(self._hash(full_key))
         while slots[i] >= 0:
             i = (i + 1) % len(slots)
         slots[i] = offset
@@ -77,7 +85,7 @@ class _OffsetTable:
         self.slots = np.full(len(self.slots) * 2, -1, dtype=np.int64)
         slots = self.slots
         for off in old:
-            i = self._idx(hash(read_key(int(off))))
+            i = self._idx(self._hash(read_key(int(off))))
             while slots[i] >= 0:
                 i = (i + 1) % len(slots)
             slots[i] = off
@@ -101,6 +109,15 @@ class TranslateStore:
     def open(self) -> "TranslateStore":
         if self.path and os.path.exists(self.path):
             if self._is_legacy_log():
+                if self.read_only:
+                    # A read-only replica must not rewrite shared on-disk
+                    # state: decode the legacy log into the in-memory tail
+                    # and leave the file untouched (only the store that owns
+                    # the append handle migrates).
+                    for ns, key, id in self._parse_legacy():
+                        off = self._append_raw(self._encode(ns, key, id))
+                        self._index_entry(off)
+                    return self
                 self._migrate_legacy()
             self._fd = os.open(self.path, os.O_RDONLY)
             self._disk_size = os.fstat(self._fd).st_size
@@ -136,8 +153,8 @@ class TranslateStore:
             return False
         return isinstance(entry, list) and len(entry) == 3
 
-    def _migrate_legacy(self) -> None:
-        """Rewrite a round-1 JSON-framed log in the binary layout."""
+    def _parse_legacy(self) -> List[Tuple[str, str, int]]:
+        """Decode a round-1 JSON-framed log into (ns, key, id) entries."""
         entries: List[Tuple[str, str, int]] = []
         with open(self.path, "rb") as f:
             data = f.read()
@@ -152,9 +169,13 @@ class TranslateStore:
                 break
             entries.append((ns, key, id))
             pos += 4 + n
+        return entries
+
+    def _migrate_legacy(self) -> None:
+        """Rewrite a round-1 JSON-framed log in the binary layout."""
         tmp = self.path + ".migrate"
         with open(tmp, "wb") as f:
-            for ns, key, id in entries:
+            for ns, key, id in self._parse_legacy():
                 f.write(self._encode(ns, key, id))
         os.replace(tmp, self.path)
 
@@ -292,12 +313,18 @@ class TranslateStore:
         return self._size
 
     def read_from(self, offset: int):
-        """Raw log bytes from offset (for replica streaming)."""
-        if not self.path or not os.path.exists(self.path):
-            return bytes(self._tail[offset:]) if offset < len(self._tail) else b""
-        with open(self.path, "rb") as f:
-            f.seek(offset)
-            return f.read()
+        """Raw log bytes from offset (for replica streaming): the binary
+        disk prefix followed by the in-memory tail, so size() and the bytes
+        served agree even on read-only replicas whose applied entries only
+        live in the tail (a chained downstream replica must see them)."""
+        out = b""
+        if self._fd is not None and offset < self._disk_size:
+            out = os.pread(self._fd, self._disk_size - offset, offset)
+            offset = self._disk_size
+        t = offset - self._disk_size
+        if t < len(self._tail):
+            out += bytes(self._tail[t:])
+        return out
 
     def apply_log(self, data: bytes) -> int:
         """Apply streamed log bytes on a replica; returns bytes consumed."""

@@ -111,6 +111,71 @@ def test_member_monitor_detects_death_and_recovery(cluster3r):
         s1b.close()
 
 
+def test_writes_survive_replica_death(cluster3r):
+    """Write fan-out tolerates a dead peer the way the read path does:
+    Set/SetRowAttrs/SetValue succeed when one replica of the target shard is
+    down (anti-entropy repairs it later), instead of raising after a client
+    timeout. The dead node gets marked unavailable by the failed forward."""
+    client = InternalClient()
+    s0 = cluster3r[0]
+    h0 = f"localhost:{s0.port}"
+    client.create_index(h0, "wr")
+    client.create_field(h0, "wr", "f")
+    client.create_field(h0, "wr", "v", {"type": "int", "min": 0, "max": 100})
+    time.sleep(0.05)
+    # Find a shard node0 owns whose OTHER replica is some other node.
+    target_shard = dead_id = None
+    for shard in range(64):
+        owners = s0.cluster.shard_nodes("wr", shard)
+        if any(n.id == s0.node.id for n in owners):
+            others = [n.id for n in owners if n.id != s0.node.id]
+            if others:
+                target_shard, dead_id = shard, others[0]
+                break
+    assert dead_id is not None
+    dead = next(s for s in cluster3r if s.node.id == dead_id)
+    dead.close()
+
+    col = target_shard * SHARD_WIDTH + 7
+    # Bit write: local apply + dead-replica forward -> still succeeds.
+    assert client.query(h0, "wr", f"Set({col}, f=2)")["results"][0] is True
+    assert dead_id in s0.cluster.unavailable
+    # Attr + BSI writes fan to ALL nodes; the dead one is now skipped fast.
+    client.query(h0, "wr", 'SetRowAttrs(f, 2, tag="x")')
+    client.query(h0, "wr", f"SetValue(col={col}, v=42)")
+    assert s0.holder.field("wr", "f").row_attr_store.attrs(2) == {"tag": "x"}
+    assert client.query(h0, "wr", "Count(Row(f=2))")["results"][0] == 1
+
+    # The surviving replica set still answers for the written bit.
+    live = [s for s in cluster3r if s.node.id != dead_id and s is not s0]
+    for s in live:
+        resp = client.query(f"localhost:{s.port}", "wr", "Count(Row(f=2))")
+        assert resp["results"][0] == 1
+
+
+def test_write_fails_when_all_owners_dead(cluster3r):
+    """If every owner of the target shard is unreachable the write raises
+    instead of silently dropping (no false ack)."""
+    client = InternalClient()
+    s0 = cluster3r[0]
+    h0 = f"localhost:{s0.port}"
+    client.create_index(h0, "wx")
+    client.create_field(h0, "wx", "f")
+    time.sleep(0.05)
+    # Find a shard node0 does NOT own.
+    target_shard = None
+    for shard in range(64):
+        owners = s0.cluster.shard_nodes("wx", shard)
+        if all(n.id != s0.node.id for n in owners):
+            target_shard = shard
+            break
+    assert target_shard is not None
+    cluster3r[1].close()
+    cluster3r[2].close()
+    with pytest.raises(ClientError):
+        client.query(h0, "wx", f"Set({target_shard * SHARD_WIDTH + 1}, f=1)")
+
+
 def test_no_available_replica_errors(cluster3r):
     client = InternalClient()
     h0 = f"localhost:{cluster3r[0].port}"

@@ -205,6 +205,67 @@ def test_merge_block_consensus(tmp_path):
     assert sets == [[], []] and clears == [[], []]
 
 
+def test_open_is_lazy_mmap_with_copy_on_write(tmp_path):
+    """Reopen parses container payloads zero-copy from an mmap (open cost
+    O(headers), no double-buffering; fragment.go:167-224 mmaps likewise).
+    Dense payloads stay read-only views until first mutation promotes them."""
+    import mmap as mmap_mod
+
+    f = make_fragment(tmp_path)
+    for col in range(0, 12000, 2):  # dense, non-runny: serializes as bitset
+        f.set_bit(3, col)
+    f.set_bit(4, 9)  # sparse container (array form)
+    f.snapshot()
+    f.close()
+
+    from pilosa_tpu.constants import SHARD_WIDTH
+
+    f2 = make_fragment(tmp_path)
+    dense = f2.storage.containers[(3 * SHARD_WIDTH) >> 16]
+    assert dense.bits is not None and not dense.bits.flags.writeable
+    assert isinstance(dense.bits.base, (mmap_mod.mmap, memoryview)) or isinstance(
+        getattr(dense.bits.base, "obj", None), mmap_mod.mmap
+    )
+    assert f2.row_count(3) == 6000 and f2.bit(4, 9)
+    # Copy-on-write: mutating the dense row must not touch the file.
+    before = open(f2.path, "rb").read()
+    assert f2.set_bit(3, 6001)
+    assert dense.bits.flags.writeable  # promoted to a private copy
+    assert f2.row_count(3) == 6001
+    # Snapshot replaces the inode; stale views stay valid and reopen agrees.
+    f2.snapshot()
+    f2.close()
+    f3 = make_fragment(tmp_path)
+    assert f3.row_count(3) == 6001 and f3.bit(4, 9)
+    f3.close()
+
+
+def test_merge_block_rejects_out_of_range_replica_data(tmp_path):
+    """Replica pairs outside the block must not wrap uint64 into phantom
+    positions that reach consensus (block 0 spans rows 0..99): they are
+    dropped before voting."""
+    from pilosa_tpu.constants import HASH_BLOCK_SIZE
+
+    f = make_fragment(tmp_path)
+    f.set_bit(0, 1)
+    # Both replicas agree on (0,1) but also send garbage: a row beyond the
+    # block and, for block_id>0 semantics, a row below it (wraps negative).
+    bad = (np.array([0, HASH_BLOCK_SIZE + 5], dtype=np.uint64),
+           np.array([1, 7], dtype=np.uint64))
+    sets, clears = f.merge_block(0, [bad, bad])
+    assert list(f.row(0).columns()) == [1]
+    assert f.row(HASH_BLOCK_SIZE + 5).count() == 0  # no phantom row
+    assert sets == [[], []] and clears == [[], []]
+    # Below-block garbage for a non-zero block wraps uint64; also dropped.
+    f.set_bit(HASH_BLOCK_SIZE * 2, 3)  # block 2
+    bad2 = (np.array([HASH_BLOCK_SIZE * 2, 1], dtype=np.uint64),
+            np.array([3, 9], dtype=np.uint64))
+    sets, clears = f.merge_block(2, [bad2, bad2])
+    assert list(f.row(HASH_BLOCK_SIZE * 2).columns()) == [3]
+    assert f.row(1).count() == 0
+    assert sets == [[], []] and clears == [[], []]
+
+
 def test_bulk_import(tmp_path):
     f = make_fragment(tmp_path)
     rows = np.array([1, 1, 2, 2, 2])

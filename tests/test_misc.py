@@ -298,6 +298,54 @@ def test_translate_store_legacy_json_migration(tmp_path):
     ts2.close()
 
 
+def test_translate_legacy_readonly_does_not_rewrite(tmp_path):
+    """A read-only replica opening a round-1 legacy log must not mutate the
+    shared on-disk file; it decodes in memory and still serves lookups and
+    downstream streaming (read-only contract)."""
+    import json as _json
+    import struct as _struct
+
+    from pilosa_tpu.translate import TranslateStore
+
+    path = str(tmp_path / "keys")
+    with open(path, "wb") as f:
+        for ns, key, id in [("i:x", "a", 1), ("i:x", "b", 2)]:
+            e = _json.dumps([ns, key, id]).encode()
+            f.write(_struct.pack("<I", len(e)) + e)
+    before = open(path, "rb").read()
+    ts = TranslateStore(path, read_only=True).open()
+    assert ts.translate_columns_to_uint64("x", ["a", "b"]) == [1, 2]
+    assert open(path, "rb").read() == before  # untouched on disk
+    # Downstream streaming serves the decoded binary entries from the tail.
+    data = ts.read_from(0)
+    assert len(data) == ts.size() and data
+    chained = TranslateStore(None, read_only=True)
+    chained.apply_log(data)
+    assert chained.translate_column_to_string("x", 2) == "b"
+    ts.close()
+
+
+def test_translate_readonly_read_from_includes_tail(tmp_path):
+    """read_from on a read-only replica with a path must serve applied log
+    entries living only in the in-memory tail — size() already counts them,
+    so a chained replica polling read_from(size) would otherwise stall."""
+    from pilosa_tpu.translate import TranslateStore
+
+    primary = TranslateStore(str(tmp_path / "primary")).open()
+    primary.translate_columns_to_uint64("i", ["a", "b"])
+    replica = TranslateStore(str(tmp_path / "replica"), read_only=True).open()
+    replica.apply_log(primary.read_from(0))
+    assert replica.size() == primary.size()
+    # The replica's copy is all tail (its own disk file is empty): stream it.
+    data = replica.read_from(0)
+    assert data == primary.read_from(0)
+    # Offsets into the tail work too.
+    assert replica.read_from(4) == data[4:]
+    assert replica.read_from(replica.size()) == b""
+    primary.close()
+    replica.close()
+
+
 def test_translate_store_memory_is_offsets_not_keys(tmp_path):
     """1M keys must not hold 1M python strings resident."""
     import sys
