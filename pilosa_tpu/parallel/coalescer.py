@@ -12,20 +12,32 @@ ShardedQueryEngine.count_batch — N queries, one dispatch.
 Latency math: a query pays at most `window` extra wait; with dispatch RTT
 >> window (tens of ms through a TPU runtime vs 1ms window) batching wins
 whenever 2+ queries overlap, and a lone query pays only the window.
+
+Batches are also capped at `max_inflight` outstanding device round trips:
+result transfers serialize on the host<->device link, so once the link is
+saturated, dispatching another small batch only adds a full RTT — blocking
+the collector instead lets the next batch grow to the arrival rate times
+the RTT (batch-to-the-bandwidth-delay-product), which is exactly the batch
+size that keeps the link busy with the fewest round trips.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
 
 class QueryCoalescer:
-    def __init__(self, engine, window: float = 0.001, max_batch: int = 256):
+    def __init__(self, engine, window: float = 0.001, max_batch: int = 256,
+                 max_inflight: int = None):
+        if max_inflight is None:
+            import os
+
+            max_inflight = int(os.environ.get("PILOSA_COALESCE_INFLIGHT", "4"))
         self.engine = engine
         self.window = window
         self.max_batch = max_batch
@@ -33,6 +45,14 @@ class QueryCoalescer:
         self._pending: List[Tuple] = []
         self._closed = False
         self._thread: threading.Thread = None
+        # Materialization (blocking on the device round trip) runs off the
+        # collector thread; the semaphore caps outstanding round trips so
+        # under saturation the collector blocks and the next batch grows
+        # instead of fragmenting into extra serialized RTTs.
+        self._inflight = threading.BoundedSemaphore(max_inflight)
+        self._finishers = ThreadPoolExecutor(
+            max_workers=max_inflight + 2, thread_name_prefix="coalescer-finish"
+        )
         self.batches_executed = 0
         self.queries_batched = 0
 
@@ -59,6 +79,7 @@ class QueryCoalescer:
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        self._finishers.shutdown(wait=True)
 
     # ------------------------------------------------------------- worker
 
@@ -99,10 +120,12 @@ class QueryCoalescer:
                 continue
             groups.setdefault(key, []).append(item + (comp_expr,))
 
-        # Dispatch every group async first (the device pipeline stays full),
-        # then materialize — N groups pay ~1 round trip, not N serialized.
-        dispatched = []
+        # Dispatch every group async (the device pipeline stays full), then
+        # hand materialization to the finisher pool so the collector starts
+        # gathering the next batch immediately — batches overlap the device
+        # round trip instead of serializing on it.
         for (index, _sig, shards), items in groups.items():
+            self._inflight.acquire()  # released by _finish
             try:
                 if len(items) == 1:
                     _, call, _, fut, comp_expr = items[0]
@@ -117,18 +140,21 @@ class QueryCoalescer:
                     )
                     self.batches_executed += 1
                     self.queries_batched += len(items)
-                dispatched.append((items, out))
+                self._finishers.submit(self._finish, items, out)
             except Exception as e:
+                self._inflight.release()
                 for it in items:
                     if not it[3].done():
                         it[3].set_exception(e)
 
-        for items, out in dispatched:
-            try:
-                counts = np.asarray(out).reshape(-1)
-                for it, n in zip(items, counts[: len(items)]):
-                    it[3].set_result(int(n))
-            except Exception as e:
-                for it in items:
-                    if not it[3].done():
-                        it[3].set_exception(e)
+    def _finish(self, items: List[Tuple], out) -> None:
+        try:
+            counts = np.asarray(out).reshape(-1)
+            for it, n in zip(items, counts[: len(items)]):
+                it[3].set_result(int(n))
+        except Exception as e:
+            for it in items:
+                if not it[3].done():
+                    it[3].set_exception(e)
+        finally:
+            self._inflight.release()

@@ -524,23 +524,32 @@ class ShardedQueryEngine:
                                             pad_pow2=True)
         up = stacked.shape[0]
 
+        # The memoized expansion rides inside the same program (a take on
+        # the (Qp,) counts): a separate jnp.take would be a second dispatch
+        # — a second full round trip per batch on a remote-runtime link.
+        invp = 0
+        inv_in = None
+        if inverse is not None:
+            invp = 1 << (len(inverse) - 1).bit_length()
+            inv_in = np.concatenate(
+                [inverse, np.zeros(invp - len(inverse), np.int32)]
+            )
+
         # sig0 is row-independent for set-op trees (Row entries carry leaf
         # positions, not row ids), so one compiled program serves any rows.
         sig = ("count_batch_setops", tuple(comps[0][0].signature),
-               len(shards), qp, up)
+               len(shards), qp, up, invp)
         def build():
             expr = comps[0][1]
             if self._use_gather_kernel():
                 from ..ops import pallas_kernels as pk
 
-                @jax.jit
-                def fn(stacked, idxs):
+                def counts_of(stacked, idxs):
                     return pk.batched_gather_expr_count(stacked, idxs, expr)
             else:
                 # XLA fallback: materializes the (Q, S, W) gathers but
                 # partitions cleanly over a multi-device mesh.
-                @jax.jit
-                def fn(stacked, idxs):
+                def counts_of(stacked, idxs):
                     leaves = tuple(stacked[ix] for ix in idxs)  # each (Q, S, W)
                     plane = expr(leaves)
                     return jnp.sum(
@@ -548,13 +557,20 @@ class ShardedQueryEngine:
                         axis=(1, 2),
                     )
 
+            if invp:
+                @jax.jit
+                def fn(stacked, idxs, inv):
+                    return jnp.take(counts_of(stacked, idxs), inv)
+            else:
+                @jax.jit
+                def fn(stacked, idxs):
+                    return counts_of(stacked, idxs)
             return fn
 
         fn = self._fn_build(self._count_fns, sig, build)
-        out = fn(stacked, idxs)
-        if inverse is not None:
-            out = jnp.take(out, inverse)  # expand memoized results to (Q,)
-        return out
+        if inv_in is not None:
+            return fn(stacked, idxs, inv_in)
+        return fn(stacked, idxs)
 
     def _use_gather_kernel(self) -> bool:
         """Fused Pallas gather kernel: single-device TPU only (the

@@ -350,6 +350,62 @@ class API:
         rows, cols = frag.block_data(block)
         return {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()}
 
+    def collective_count(self, index: str, field: str, rows: List[int]) -> int:
+        """Leader side of multi-host collective execution: broadcast the
+        query descriptor so every jax.distributed process enters the same
+        global-mesh program, then enter it locally. The all-reduced count
+        (Intersect over `rows`) materializes on every host; the leader
+        answers. Degenerates to a local device count on single-process
+        jobs (parallel/distributed.py).
+
+        The broadcast must NOT wait for peer responses: a peer's message
+        handler blocks inside the collective until every process (this
+        leader included) has entered, so a synchronous broadcast would
+        deadlock leader-waiting-on-peer-waiting-on-leader."""
+        import threading
+
+        from ..parallel.distributed import CollectiveWorker
+
+        self._validate("collective_count")
+        if not rows:
+            raise QueryError("collective_count requires at least one row")
+        if len(self.cluster.nodes) > 1:
+            import jax
+
+            if jax.process_count() < len(self.cluster.nodes):
+                # Without a shared job each node's "global" mesh is just its
+                # local devices and the count would silently miss peer-owned
+                # shards — refuse rather than return a wrong answer.
+                raise ApiError(
+                    "collective_count requires a jax.distributed job spanning "
+                    f"the cluster ({len(self.cluster.nodes)} nodes, "
+                    f"{jax.process_count()} jax processes); "
+                    "set PILOSA_JAX_COORDINATOR on every node"
+                )
+        idx = self.holder.index(index)
+        if idx is None:
+            from ..errors import IndexNotFoundError
+
+            raise IndexNotFoundError(index)
+        n_shards = idx.max_shard() + 1
+        msg = {
+            "type": "collective-count", "index": index, "field": field,
+            "rows": list(rows), "nShards": n_shards,
+        }
+        def send(node):
+            try:
+                self.server.client.send_message(node, msg)
+            except PilosaError as e:
+                self.server.logger.error(
+                    "collective broadcast to %s failed: %s", node.id, e
+                )
+
+        for node in self.cluster.nodes:
+            if node.id == self.cluster.node.id:
+                continue
+            threading.Thread(target=send, args=(node,), daemon=True).start()
+        return CollectiveWorker(self.holder).enter(index, field, rows, n_shards)
+
     def cluster_message(self, msg: dict) -> None:
         self._validate("cluster_message")
         self.server.receive_message(msg)

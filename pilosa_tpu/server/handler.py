@@ -106,6 +106,7 @@ class Handler:
             Route("POST", r"/cluster/resize/remove-node", self.handle_remove_node),
             Route("POST", r"/cluster/resize/set-coordinator", self.handle_set_coordinator),
             Route("POST", r"/internal/cluster/message", self.handle_cluster_message),
+            Route("POST", r"/internal/collective/count", self.handle_collective_count),
             Route("GET", r"/internal/fragment/blocks", self.handle_fragment_blocks),
             Route("GET", r"/internal/fragment/block/data", self.handle_fragment_block_data),
             Route("POST", r"/internal/fragment/block/data", self.handle_post_block_data),
@@ -360,6 +361,14 @@ class Handler:
         self.api.cluster_message(_json_body(body))
         return {}
 
+    def handle_collective_count(self, body, **kw):
+        data = _json_body(body)
+        return {
+            "count": self.api.collective_count(
+                data["index"], data["field"], data.get("rows", [])
+            )
+        }
+
     def handle_fragment_blocks(self, query, **kw):
         # view is optional for reference parity (its RPC has no view param);
         # absent means standard.
@@ -531,10 +540,20 @@ class _RequestHandler(BaseHTTPRequestHandler):
         pass
 
 
+class _Server(ThreadingHTTPServer):
+    # The stdlib default backlog of 5 drops (RSTs) connections under
+    # concurrent load — 16 clients opening sockets faster than the accept
+    # loop drains them is routine for a serving benchmark, let alone
+    # production. Match Go's effective unbounded accept behavior closely
+    # enough that the OS queue, not the library, is the limit.
+    request_queue_size = 128
+    daemon_threads = True
+
+
 def serve(handler: Handler, host: str = "localhost", port: int = 0,
           ssl_context=None) -> Tuple[ThreadingHTTPServer, threading.Thread, int]:
     cls = type("BoundHandler", (_RequestHandler,), {"handler": handler})
-    httpd = ThreadingHTTPServer((host, port), cls)
+    httpd = _Server((host, port), cls)
     if ssl_context is not None:
         # https bind (reference server/server.go:367-375 getListener wraps
         # the listener in tls.Listen when the bind scheme is https).

@@ -170,6 +170,18 @@ class Server:
     def open(self) -> "Server":
         """Open sequence (reference server.go:311-357)."""
         self._raise_file_limit()
+        # Multi-host mesh: join the jax.distributed job when configured
+        # (PILOSA_JAX_COORDINATOR/NUM_PROCESSES/PROCESS_ID). No-op for
+        # single-host deployments. Must happen before any backend use.
+        from ..parallel import distributed
+
+        if distributed.initialize():
+            import jax
+
+            self.logger.info(
+                "joined jax.distributed job: process %d/%d, %d global devices",
+                jax.process_index(), jax.process_count(), jax.device_count(),
+            )
         self.translate_store.open()
         self._httpd, self._http_thread, actual_port = serve(
             self.handler, self.host, self.port, ssl_context=self._ssl_context()
@@ -651,6 +663,16 @@ class Server:
             self.handle_node_join(Node.from_dict(msg["node"]))
         elif typ == "node-leave":
             self.handle_node_leave(msg["nodeID"])
+        elif typ == "collective-count":
+            # Non-leader side of leader-driven collective serving: enter the
+            # same global-mesh program as the broadcasting leader (SPMD
+            # requires every process to participate; see
+            # parallel/distributed.py CollectiveWorker).
+            from ..parallel.distributed import CollectiveWorker
+
+            CollectiveWorker(self.holder).enter(
+                msg["index"], msg["field"], msg["rows"], msg["nShards"]
+            )
         elif typ == "node-state":
             pass  # coordinator bookkeeping; static clusters are always NORMAL
         else:
