@@ -322,9 +322,14 @@ class Fragment:
 
     # ----------------------------------------------------------------- TopN
 
-    def top(self, opt: TopOptions) -> List[Pair]:
+    def top(self, opt: TopOptions, inter_counts: Optional[Dict[int, int]] = None) -> List[Pair]:
+        """TopN over this fragment. `inter_counts` (row -> |row ∩ src| for
+        THIS shard) lets the executor batch the device popcounts for many
+        shards into one program and replay the heap here without any
+        per-fragment device work (heap semantics: fragment.go:899-990)."""
         pairs = self._top_pairs(list(opt.row_ids))
         n = 0 if opt.row_ids else opt.n
+        has_src = opt.src is not None or inter_counts is not None
 
         filters = set(opt.filter_values) if opt.filter_name and opt.filter_values else None
 
@@ -339,28 +344,12 @@ class Fragment:
 
         # Pre-filter candidates (cheap host checks), then batch-count the
         # survivors' intersections with src on device.
-        candidates: List[Tuple[int, int]] = []  # (row_id, cnt)
-        for p in pairs:
-            row_id, cnt = p.id, p.count
-            if cnt <= 0:
-                continue
-            if tanimoto > 0:
-                if cnt <= min_tan or cnt >= max_tan:
-                    continue
-            elif cnt < opt.min_threshold:
-                continue
-            if filters is not None:
-                attrs = (
-                    self.row_attr_store.attrs(row_id) if self.row_attr_store else None
-                )
-                if not attrs:
-                    continue
-                if attrs.get(opt.filter_name) not in filters:
-                    continue
-            candidates.append((row_id, cnt))
+        candidates = self._filter_candidates(pairs, opt, min_tan, max_tan, filters)
 
         inter: Dict[int, int] = {}
-        if opt.src is not None and candidates:
+        if inter_counts is not None:
+            inter = {int(r): int(c) for r, c in inter_counts.items()}
+        elif opt.src is not None and candidates:
             src_plane = self._filter_plane(opt.src)
             for i in range(0, len(candidates), TOPN_BATCH):
                 chunk = candidates[i : i + TOPN_BATCH]
@@ -375,7 +364,7 @@ class Fragment:
         out: List[Pair] = []
         for row_id, cnt in candidates:
             if n == 0 or len(results) < n:
-                count = inter[row_id] if opt.src is not None else cnt
+                count = inter.get(row_id, 0) if has_src else cnt
                 if count == 0:
                     continue
                 if tanimoto > 0:
@@ -387,20 +376,51 @@ class Fragment:
                 elif count < opt.min_threshold:
                     continue
                 heapq.heappush(results, (count, row_id))
-                if n > 0 and len(results) == n and opt.src is None:
+                if n > 0 and len(results) == n and not has_src:
                     break
                 continue
 
             threshold = results[0][0]
             if threshold < opt.min_threshold or cnt < threshold:
                 break
-            count = inter[row_id] if opt.src is not None else cnt
+            count = inter.get(row_id, 0) if has_src else cnt
             if count < threshold:
                 continue
             heapq.heappush(results, (count, row_id))
 
         out = sort_pairs([Pair(id=r, count=c) for c, r in results])
         return out
+
+    def _filter_candidates(self, pairs, opt: TopOptions, min_tan: float,
+                           max_tan: float, filters) -> List[Tuple[int, int]]:
+        candidates: List[Tuple[int, int]] = []  # (row_id, cnt)
+        for p in pairs:
+            row_id, cnt = p.id, p.count
+            if cnt <= 0:
+                continue
+            if opt.tanimoto_threshold > 0 and opt.src is not None:
+                if cnt <= min_tan or cnt >= max_tan:
+                    continue
+            elif cnt < opt.min_threshold:
+                continue
+            if filters is not None:
+                attrs = (
+                    self.row_attr_store.attrs(row_id) if self.row_attr_store else None
+                )
+                if not attrs:
+                    continue
+                if attrs.get(opt.filter_name) not in filters:
+                    continue
+            candidates.append((row_id, cnt))
+        return candidates
+
+    def top_candidates(self, opt: TopOptions) -> List[Tuple[int, int]]:
+        """Pre-filtered (row_id, cache_count) candidates for a TopN pass —
+        the host-side half of top(), exposed so the executor can batch the
+        device half (src intersections) across many fragments."""
+        pairs = self._top_pairs(list(opt.row_ids))
+        filters = set(opt.filter_values) if opt.filter_name and opt.filter_values else None
+        return self._filter_candidates(pairs, opt, 0.0, 0.0, filters)
 
     def _top_pairs(self, row_ids: List[int]) -> List[Pair]:
         if self.cache_type == CACHE_TYPE_NONE and not row_ids:

@@ -582,6 +582,62 @@ class Executor:
                         pairs[row_id] = pairs.get(row_id, 0) + count
                 return [Pair(id=r, count=n) for r, n in pairs.items()]
 
+        elif (
+            src_call is not None
+            and not ids
+            and not c.args.get("attrName")
+            and not tanimoto
+            and self.engine.supports(src_call)
+        ):
+            # Batched phase-1: each shard's candidate list comes from its
+            # host rank cache (cheap), but the src intersections for the
+            # UNION of candidates across all local shards run as ONE device
+            # program — the per-fragment fallback pays a device round trip
+            # per plane chunk per shard (seconds through a remote runtime).
+            # Heap semantics stay exact: Fragment.top replays them from the
+            # precomputed per-shard counts (fragment.go:899-990).
+            field_name = c.args.get("_field") or DEFAULT_FIELD
+            n_arg, _ = c.uint_arg("n")
+            thr = max(c.uint_arg("threshold")[0], DEFAULT_MIN_THRESHOLD)
+            topn_opt = TopOptions(n=n_arg, min_threshold=thr)
+
+            def local_runner(local_shards):
+                frags = []
+                union: List[int] = []
+                seen = set()
+                for s in local_shards:
+                    frag = self.holder.fragment(index, field_name, VIEW_STANDARD, s)
+                    if frag is None:
+                        continue
+                    cands = frag.top_candidates(topn_opt)
+                    frags.append((frag, cands))
+                    for r, _ in cands:
+                        if r not in seen:
+                            seen.add(r)
+                            union.append(r)
+                if not frags or not union:
+                    return []
+                shard_list = [f.shard for f, _ in frags]
+                inter_by_shard: Dict[int, Dict[int, int]] = {
+                    s: {} for s in shard_list
+                }
+                CHUNK = 512  # bounds the (R, S, W) gather working set
+                for i in range(0, len(union), CHUNK):
+                    chunk = union[i : i + CHUNK]
+                    _, inter = self.engine.topn_shard_counts(
+                        index, field_name, chunk, shard_list, src_call
+                    )
+                    for ri, r in enumerate(chunk):
+                        for si, s in enumerate(shard_list):
+                            inter_by_shard[s][r] = int(inter[ri, si])
+                out: List[Pair] = []
+                for frag, cands in frags:
+                    counts = {
+                        r: inter_by_shard[frag.shard].get(r, 0) for r, _ in cands
+                    }
+                    out.extend(frag.top(topn_opt, inter_counts=counts))
+                return add_pairs([], out)
+
         if local_runner is not None:
             result = self._fan_out(index, shards, c, opt, local_runner, add_pairs) or []
         else:

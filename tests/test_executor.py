@@ -108,6 +108,50 @@ def test_topn_with_src(holder, ex):
     assert [(p.id, p.count) for p in pairs] == [(10, 2), (20, 1)]
 
 
+def test_topn_with_src_batched_matches_fallback(holder, ex):
+    """Phase-1-with-src runs as ONE batched device program across shards
+    (union of per-shard cache candidates -> engine.topn_shard_counts ->
+    per-shard heap replay). Results must be identical to the per-fragment
+    fallback path (forced by pretending the engine can't compile src)."""
+    import numpy as np
+
+    setup_index(holder)
+    rng = np.random.default_rng(17)
+    fld = holder.index("i").field("f")
+    g = holder.index("i").field("g")
+    n_rows, n_shards = 24, 3
+    rows, cols = [], []
+    for row in range(n_rows):
+        for s in range(n_shards):
+            c = rng.choice(4096, size=64 + row, replace=False)
+            rows.extend([row] * len(c))
+            cols.extend(int(s * SHARD_WIDTH + x) for x in c)
+    fld.import_bits(rows, cols)
+    gc = [int(s * SHARD_WIDTH + x)
+          for s in range(n_shards) for x in rng.choice(4096, 1500, replace=False)]
+    g.import_bits([3] * len(gc), gc)
+
+    q = "TopN(f, Row(g=3), n=7, threshold=2)"
+    got = [(p.id, p.count) for p in ex.execute("i", q)[0]]
+
+    real_supports = ex.engine.supports
+    src_ast = None
+
+    def no_src_supports(call):
+        # Refuse only the src Row so the executor takes the per-fragment
+        # fallback; the phase-2 refetch path is disabled the same way.
+        if call.name == "Row" and call.args.get("g") is not None:
+            return False
+        return real_supports(call)
+
+    ex.engine.supports = no_src_supports
+    try:
+        want = [(p.id, p.count) for p in ex.execute("i", q)[0]]
+    finally:
+        ex.engine.supports = real_supports
+    assert got == want and got, (got, want)
+
+
 def test_sum_min_max(holder, ex):
     idx = setup_index(holder)
     idx.create_field_if_not_exists("v", FieldOptions(type="int", min=-10, max=1000))
