@@ -34,6 +34,13 @@ class Topology:
                 data = json.load(f)
             t.node_ids = data.get("nodeIDs", [])
             t.nodes = [Node.from_dict(n) for n in data.get("nodes", [])]
+            if t.node_ids and not t.nodes:
+                # Legacy topology format persisted only nodeIDs. In static
+                # mode node id == URI (server._join_cluster), so the ids are
+                # dialable and STARTING recovery (_solicit_topology_members)
+                # keeps working for clusters whose checkpoint predates the
+                # full-record format.
+                t.nodes = [Node(id=nid, uri=nid) for nid in t.node_ids]
         return t
 
     def save(self, nodes: List[Node]) -> None:

@@ -38,6 +38,15 @@ DEFAULT_MIN_THRESHOLD = 1
 _WRITE_CALLS = {"Set", "Clear", "SetValue", "SetRowAttrs", "SetColumnAttrs"}
 
 
+def _is_node_failure(e) -> bool:
+    """True when a ClientError indicates the NODE failed (connect/transport
+    error carries status 0, server fault is 5xx) rather than the REQUEST
+    (4xx application errors are deterministic: the peer is healthy and
+    every replica would answer the same)."""
+    status = getattr(e, "status", 0)
+    return status == 0 or status >= 500
+
+
 @dataclass
 class ExecOptions:
     remote: bool = False
@@ -260,7 +269,13 @@ class Executor:
                     v = self.client.query_node(
                         node, index, str(c), shards=node_shards, remote=True
                     )[0]
-                except ClientError:
+                except ClientError as e:
+                    if not _is_node_failure(e):
+                        # 4xx: the peer executed and rejected the query —
+                        # a deterministic application error that every
+                        # replica would reproduce. Surface it instead of
+                        # misclassifying a healthy node as dead.
+                        raise
                     # Mark failed, re-map its shards onto replicas
                     # (executor.go:1498-1508 mapper retry).
                     failed.add(node_id)
@@ -700,6 +715,7 @@ class Executor:
         ret = False
         applied = 0
         errors = []
+        app_error = None
         for node in self.cluster.shard_nodes(index, shard):
             if node.id == self.node.id:
                 if local_fn():
@@ -716,6 +732,14 @@ class Executor:
             try:
                 res = self.client.query_node(node, index, str(c), remote=True)
             except ClientError as e:
+                if not _is_node_failure(e):
+                    # The replica is alive and rejected the write (4xx):
+                    # surface the divergence — but only after the remaining
+                    # owners got their forward, or one lagging replica would
+                    # cause extra divergence on the others.
+                    app_error = app_error or e
+                    errors.append(f"{node.id}: {e}")
+                    continue
                 self.cluster.mark_unavailable(node.id)
                 self.holder.stats.count("WriteForwardFailed", 1)
                 errors.append(f"{node.id}: {e}")
@@ -723,6 +747,8 @@ class Executor:
             applied += 1
             if res and isinstance(res[0], bool):
                 ret = ret or res[0]
+        if app_error is not None:
+            raise app_error
         if applied == 0:
             raise QueryError(
                 f"write failed on all owners of {index}/shard {shard}: "
@@ -820,6 +846,7 @@ class Executor:
 
         if opt.remote:
             return
+        app_error = None
         for node in self.cluster.nodes:
             if node.id == self.node.id:
                 continue
@@ -828,9 +855,16 @@ class Executor:
                 continue
             try:
                 self.client.query_node(node, index, str(c), remote=True)
-            except ClientError:
+            except ClientError as e:
+                if not _is_node_failure(e):
+                    # Deterministic rejection by a live peer: finish the
+                    # fan-out (don't widen divergence), then surface it.
+                    app_error = app_error or e
+                    continue
                 self.cluster.mark_unavailable(node.id)
                 self.holder.stats.count("WriteForwardFailed", 1)
+        if app_error is not None:
+            raise app_error
 
     # ---------------------------------------------------------- translation
 

@@ -82,7 +82,11 @@ class InternalClient:
             return wire.decode_results(raw)
         data = json.loads(raw)
         if "error" in data:
-            raise ClientError(data["error"])
+            # The peer executed the request and rejected it: a deterministic
+            # application error, not node death. status=400 lets callers
+            # (executor retry logic) distinguish it from transport failures
+            # (status=0) and server faults (5xx).
+            raise ClientError(data["error"], status=400)
         return [deserialize_remote(r) for r in data["results"]]
 
     def query(self, host: str, index: str, query: str, **params) -> dict:

@@ -89,13 +89,35 @@ class Container:
     """One 2^16-bit block: sorted uint16 array (sparse) or uint64 bitset
     (dense). `n` is always the exact cardinality."""
 
-    __slots__ = ("arr", "bits", "n")
+    __slots__ = ("arr", "bits", "n", "nv")
 
     def __init__(self, arr: Optional[np.ndarray] = None,
                  bits: Optional[np.ndarray] = None, n: Optional[int] = None):
         self.arr = arr
         self.bits = bits
         self.n = (len(arr) if arr is not None else _popcount(bits)) if n is None else n
+        # n-verified: False only for lazily-opened bitset containers whose
+        # header cardinality was trusted without paging in the payload
+        # (Bitmap.from_buffer copy=False); verify_n() settles it on first use.
+        self.nv = True
+
+    def verify_n(self) -> None:
+        """Validate a header-trusted cardinality on first touch: the mmap
+        open path (fragment.open -> from_buffer copy=False) trusts the
+        on-disk n so open stays O(headers); the first count/mutation of the
+        container recomputes the popcount and raises on mismatch, so a
+        corrupt file is detected instead of silently poisoning count math."""
+        if self.nv:
+            return
+        real = _popcount(self.bits)
+        if real != self.n:
+            # Leave nv False so EVERY touch keeps raising — a caller that
+            # catches one error must not get silently-poisoned counts next.
+            raise ValueError(
+                f"corrupt bitmap container: header cardinality {self.n} != "
+                f"payload popcount {real}"
+            )
+        self.nv = True
 
     # ------------------------------------------------------------ factories
 
@@ -142,6 +164,7 @@ class Container:
     # ------------------------------------------------------------ point ops
 
     def add(self, low: int) -> bool:
+        self.verify_n()
         if self.bits is not None:
             w, b = low >> 6, np.uint64(low & 63)
             if (self.bits[w] >> b) & _WORD_ONE:
@@ -159,6 +182,7 @@ class Container:
         return True
 
     def remove(self, low: int) -> bool:
+        self.verify_n()
         if self.bits is not None:
             w, b = low >> 6, np.uint64(low & 63)
             if not (self.bits[w] >> b) & _WORD_ONE:
@@ -185,6 +209,7 @@ class Container:
 
     def add_sorted(self, chunk: np.ndarray) -> None:
         """Union in a sorted unique uint16 chunk."""
+        self.verify_n()
         if self.bits is None and self.n + len(chunk) > ARRAY_MAX_SIZE:
             self._force_densify()
         if self.bits is not None:
@@ -197,6 +222,7 @@ class Container:
             self._maybe_densify()
 
     def remove_sorted(self, chunk: np.ndarray) -> None:
+        self.verify_n()
         if self.bits is not None:
             bits = self._mutable_bits()
             bits &= ~_arr_to_words(chunk)
@@ -215,6 +241,7 @@ class Container:
     def count_range(self, lo: int, hi: int) -> int:
         """Set bits in [lo, hi); hi may be 65536."""
         if lo <= 0 and hi >= 1 << 16:
+            self.verify_n()
             return self.n
         if self.arr is not None:
             i = np.searchsorted(self.arr, np.uint16(lo)) if lo > 0 else 0
@@ -296,7 +323,9 @@ class Container:
 
     def copy(self) -> "Container":
         if self.bits is not None:
-            return Container(bits=self.bits.copy(), n=self.n)
+            c = Container(bits=self.bits.copy(), n=self.n)
+            c.nv = self.nv  # an unverified n must not launder through a copy
+            return c
         return Container(arr=self.arr.copy(), n=self.n)
 
     def __len__(self) -> int:
@@ -499,7 +528,12 @@ class Bitmap:
                 self._drop(key)
 
     def count(self) -> int:
-        return sum(_as_container(c).n for c in self.containers.values())
+        total = 0
+        for c in self.containers.values():
+            c = _as_container(c)
+            c.verify_n()  # settles header-trusted n on the lazy open path
+            total += c.n
+        return total
 
     def any(self) -> bool:
         return bool(self.containers)
@@ -678,6 +712,11 @@ class Bitmap:
         # Pick the smallest of array / bitmap / run per container.
         payloads = []
         for key, cont in items:
+            # A lazy-opened container may still carry a header-trusted n;
+            # serializing with a corrupt n would write an internally
+            # inconsistent file (array form reads back n elements and
+            # misparses the tail as op-log). Settle it now.
+            cont.verify_n()
             n = cont.n
             arr = cont.to_array()
             runs = self._runs(arr)
@@ -754,13 +793,15 @@ class Bitmap:
                 # In copy mode cardinality is derived from the payload so a
                 # corrupt/foreign n field cannot poison count math; in lazy
                 # mode recounting would page in every dense container, so
-                # the header n is trusted (as the reference reader does,
-                # roaring.go UnmarshalBinary) and `check()` still validates.
+                # the header n is provisionally trusted (as the reference
+                # reader does, roaring.go UnmarshalBinary) and settled by
+                # Container.verify_n on the first count/mutation touch.
                 if copy:
                     c = Container(bits=words.astype(np.uint64))
                     n = c.n
                 else:
                     c = Container(bits=words, n=n)
+                    c.nv = False
                 ops_offset = max(ops_offset, off + 8 * BITMAP_N)
             elif typ == CONTAINER_RUN:
                 run_n = struct.unpack_from("<H", data, off)[0]

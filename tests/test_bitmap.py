@@ -242,3 +242,67 @@ def test_direct_container_assignment_updates_key_cache():
     b.containers[1 << 10] = np.array([7], dtype=np.uint16)  # legacy direct set
     assert b.count_range((1 << 10) << 16, ((1 << 10) + 1) << 16) == 1
     assert ((1 << 26) | 7) in set(b.slice().tolist())
+
+
+def test_lazy_open_detects_corrupt_header_cardinality():
+    # The mmap open path trusts the header n at parse time (open stays
+    # O(headers)); the first count/mutation touch must recompute and raise
+    # (ADVICE r3: a corrupt n silently poisoned Count on the lazy path).
+    import struct
+
+    from pilosa_tpu.storage.bitmap import HEADER_BASE_SIZE
+
+    b = Bitmap(np.arange(0, 1 << 16, 2, dtype=np.uint64))  # one dense bitset
+    data = bytearray(b.to_bytes())
+    n_off = HEADER_BASE_SIZE + 8 + 2  # first container header's n-1 field
+    (n_minus_1,) = struct.unpack_from("<H", data, n_off)
+    assert n_minus_1 + 1 == 1 << 15
+    struct.pack_into("<H", data, n_off, n_minus_1 - 1000)  # corrupt n
+    lazy = Bitmap.from_buffer(bytes(data), copy=False)
+    with pytest.raises(ValueError, match="corrupt"):
+        lazy.count()
+    # Eager parse derives n from the payload, so it self-heals.
+    assert Bitmap.from_bytes(bytes(data)).count() == 1 << 15
+
+
+def test_lazy_open_verifies_on_mutation():
+    import struct
+
+    from pilosa_tpu.storage.bitmap import HEADER_BASE_SIZE
+
+    b = Bitmap(np.arange(0, 1 << 16, 2, dtype=np.uint64))
+    data = bytearray(b.to_bytes())
+    n_off = HEADER_BASE_SIZE + 8 + 2
+    (n_minus_1,) = struct.unpack_from("<H", data, n_off)
+    struct.pack_into("<H", data, n_off, n_minus_1 - 7)
+    lazy = Bitmap.from_buffer(bytes(data), copy=False)
+    with pytest.raises(ValueError, match="corrupt"):
+        lazy.add(1)
+    # An uncorrupted lazy open counts fine and settles the flag.
+    ok = Bitmap.from_buffer(b.to_bytes(), copy=False)
+    assert ok.count() == 1 << 15
+    assert ok.count() == 1 << 15  # second count: verified path
+
+
+def test_corrupt_container_keeps_raising_and_wont_serialize():
+    # A caught first error must not silently poison later counts, and
+    # to_bytes must refuse to write an internally inconsistent file.
+    import struct
+
+    from pilosa_tpu.storage.bitmap import HEADER_BASE_SIZE
+
+    b = Bitmap(np.arange(0, 1 << 16, 2, dtype=np.uint64))
+    data = bytearray(b.to_bytes())
+    n_off = HEADER_BASE_SIZE + 8 + 2
+    (n_minus_1,) = struct.unpack_from("<H", data, n_off)
+    struct.pack_into("<H", data, n_off, n_minus_1 - 1000)
+    lazy = Bitmap.from_buffer(bytes(data), copy=False)
+    for _ in range(2):  # raises EVERY time, not just once
+        with pytest.raises(ValueError, match="corrupt"):
+            lazy.count()
+    with pytest.raises(ValueError, match="corrupt"):
+        lazy.to_bytes()
+    # copy() must not launder an unverified n either.
+    lazy2 = Bitmap.from_buffer(bytes(data), copy=False)
+    with pytest.raises(ValueError, match="corrupt"):
+        lazy2.clone().count()

@@ -12,6 +12,7 @@ import pytest
 
 from pilosa_tpu.cluster.hash import ModHasher
 from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.errors import PilosaError
 from pilosa_tpu.server.client import ClientError, InternalClient
 from pilosa_tpu.server.server import Server
 
@@ -195,3 +196,68 @@ def test_no_available_replica_errors(cluster3r):
     if unreachable:
         with pytest.raises(ClientError):
             client.query(h0, "fx", "Count(Row(f=1))")
+
+
+def test_4xx_replica_error_not_misclassified_as_node_death():
+    """ADVICE r3: a deterministic application error (4xx) from a replica
+    must surface to the caller, not mark the healthy node unavailable."""
+    from pilosa_tpu.cluster.node import Cluster, Node
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+
+    nodes = [Node(id="n0"), Node(id="n1")]
+    cluster = Cluster(node=nodes[0], nodes=nodes, replica_n=1, hasher=ModHasher())
+
+    class FakeClient:
+        def __init__(self, status):
+            self.status = status
+            self.calls = 0
+
+        def query_node(self, node, index, query, shards=None, remote=True):
+            self.calls += 1
+            raise ClientError("boom", status=self.status)
+
+    holder = Holder(None)
+    holder.open()
+    idx = holder.create_index("fz")
+    idx.create_field("f")
+    # Ensure some shard in 0..3 is owned by the remote node (ModHasher).
+    remote_shard = next(
+        s for s in range(4)
+        if cluster.shard_nodes("fz", s)[0].id == "n1"
+    )
+
+    # 400: surfaces, node stays available.
+    client = FakeClient(400)
+    ex = Executor(holder, cluster=cluster, client=client, workers=0)
+    with pytest.raises(ClientError):
+        ex.execute("fz", "Count(Row(f=1))", shards=[remote_shard])
+    assert "n1" not in cluster.unavailable
+    assert client.calls == 1
+
+    # Transport failure (status 0): marked unavailable, shards re-mapped
+    # (single replica here, so the retry exhausts owners and errors).
+    cluster.unavailable.clear()
+    client = FakeClient(0)
+    ex = Executor(holder, cluster=cluster, client=client, workers=0)
+    with pytest.raises(PilosaError):
+        ex.execute("fz", "Count(Row(f=1))", shards=[remote_shard])
+    assert "n1" in cluster.unavailable
+
+
+def test_legacy_topology_without_node_records_still_solicits():
+    """ADVICE r3: topology files that predate full node records (nodeIDs
+    only) must still let a restarting coordinator dial prior members —
+    ids are URIs in static mode."""
+    import json
+    import tempfile
+
+    from pilosa_tpu.cluster.topology import Topology
+
+    with tempfile.TemporaryDirectory() as d:
+        path = f"{d}/.topology"
+        with open(path, "w") as f:
+            json.dump({"nodeIDs": ["localhost:1001", "localhost:1002"]}, f)
+        t = Topology.load(path)
+        assert [n.id for n in t.nodes] == ["localhost:1001", "localhost:1002"]
+        assert [n.uri for n in t.nodes] == ["localhost:1001", "localhost:1002"]
