@@ -25,13 +25,26 @@ class Node:
     id: str
     uri: str = ""
     is_coordinator: bool = False
+    # jax.distributed process index when this node is part of a multi-host
+    # device-mesh job (None otherwise). The collective plane needs every
+    # node's index to map jump-hash shard placement onto global-array slots
+    # (parallel/collective.py placement); it propagates via node-join /
+    # cluster-status messages and the member monitor's status probes.
+    process_idx: Optional[int] = None
 
     def to_dict(self):
-        return {"id": self.id, "uri": self.uri, "isCoordinator": self.is_coordinator}
+        d = {"id": self.id, "uri": self.uri, "isCoordinator": self.is_coordinator}
+        if self.process_idx is not None:
+            d["processIdx"] = self.process_idx
+        return d
 
     @classmethod
     def from_dict(cls, d):
-        return cls(id=d["id"], uri=d.get("uri", ""), is_coordinator=d.get("isCoordinator", False))
+        return cls(
+            id=d["id"], uri=d.get("uri", ""),
+            is_coordinator=d.get("isCoordinator", False),
+            process_idx=d.get("processIdx"),
+        )
 
 
 class Cluster:

@@ -102,6 +102,14 @@ class Executor:
         self.coalesce_window = coalesce_window
         self._coalescer = None  # lazy QueryCoalescer (when window > 0)
         self._coalescer_init_lock = threading.Lock()
+        # Multi-host collective backend (parallel/collective.py), wired by
+        # the server. When a jax.distributed job spans the cluster, full-
+        # index fast-path queries run as ONE SPMD program over the global
+        # mesh instead of the HTTP fan-out; failures fall back to fan-out.
+        self.collective = None
+        from .logger import NopLogger
+
+        self.logger = NopLogger()  # server wires its logger in open()
 
     @property
     def engine(self):
@@ -203,6 +211,32 @@ class Executor:
             return self._execute_topn(index, c, shards, opt)
         return self._execute_bitmap_call(index, c, shards, opt)
 
+    # ---------------------------------------------------------- collective
+
+    def _collective_ok(self, index: str, shards: List[int], opt: ExecOptions) -> bool:
+        """True when the multi-host collective plane should serve this
+        query: a jax.distributed job spans the cluster and the query covers
+        the full shard range (the collective program always covers all
+        shards; subsets go through the fan-out)."""
+        c = self.collective
+        if c is None or opt.remote or not shards:
+            return False
+        try:
+            if not c.active():
+                return False
+        except Exception:
+            return False
+        idx = self.holder.index(index)
+        if idx is None:
+            return False
+        return set(shards) == set(range(idx.max_shard() + 1))
+
+    def _collective_fallback(self, e) -> None:
+        """Record WHY the fast path refused, where the decision was made —
+        a climbing CollectiveFallback counter is undiagnosable without it."""
+        self.holder.stats.count("CollectiveFallback", 1)
+        self.logger.error("collective fallback: %s", e)
+
     # ----------------------------------------------------------- mapReduce
 
     def _assign_shards(self, index: str, shards: List[int], exclude=()):
@@ -253,9 +287,18 @@ class Executor:
 
         result = None
         failed: set = set()
+        app_error = None
         pending = list(shards)
         while pending:
-            local, remote = self._assign_shards(index, pending, exclude=failed)
+            try:
+                local, remote = self._assign_shards(index, pending, exclude=failed)
+            except PilosaError:
+                if app_error is not None:
+                    # Owners exhausted chasing a deterministic 4xx (e.g.
+                    # schema lag on every replica): the application error is
+                    # the real story, not "no available node".
+                    raise app_error
+                raise
             pending = []
             if local:
                 v = local_runner(local)
@@ -271,11 +314,15 @@ class Executor:
                     )[0]
                 except ClientError as e:
                     if not _is_node_failure(e):
-                        # 4xx: the peer executed and rejected the query —
-                        # a deterministic application error that every
-                        # replica would reproduce. Surface it instead of
-                        # misclassifying a healthy node as dead.
-                        raise
+                        # 4xx: the peer executed and rejected the query.
+                        # The node is healthy — do NOT mark it unavailable —
+                        # but the error may be transient schema lag, so try
+                        # the shards on a replica first and only surface the
+                        # error once owners are exhausted.
+                        app_error = app_error or e
+                        failed.add(node_id)
+                        pending.extend(node_shards)
+                        continue
                     # Mark failed, re-map its shards onto replicas
                     # (executor.go:1498-1508 mapper retry).
                     failed.add(node_id)
@@ -438,6 +485,16 @@ class Executor:
             raise QueryError("Count() only accepts a single bitmap input")
         child = c.children[0]
 
+        if self._collective_ok(index, shards, opt) and self.engine.supports(child):
+            from .parallel.collective import CollectiveUnavailable
+
+            try:
+                result = int(self.collective.count(index, child))
+                self.holder.stats.count("CollectiveCount", 1)
+                return result
+            except CollectiveUnavailable as e:
+                self._collective_fallback(e)
+
         def map_fn(shard):
             return self._execute_bitmap_call_shard(index, child, shard).count()
 
@@ -485,6 +542,23 @@ class Executor:
         fld = self.holder.field(index, field_name)
         bsig = fld.bsi_group(field_name) if fld else None
         filter_call = c.children[0] if c.children else None
+
+        if (
+            bsig is not None
+            and (filter_call is None or self.engine.supports(filter_call))
+            and self._collective_ok(index, shards, opt)
+        ):
+            from .parallel.collective import CollectiveUnavailable
+
+            try:
+                result = self._collective_val_count(
+                    index, field_name, bsig, kind, filter_call
+                )
+                self.holder.stats.count("CollectiveValCount", 1)
+                return result
+            except CollectiveUnavailable as e:
+                self._collective_fallback(e)
+
         local_runner = None
         if bsig is not None and (
             filter_call is None or self.engine.supports(filter_call)
@@ -494,21 +568,10 @@ class Executor:
             depth = bsig.bit_depth()
 
             def local_runner(local_shards):
-                if kind == "sum":
-                    counts = self.engine.bsi_val_count(
-                        index, field_name, "sum", depth, local_shards, filter_call
-                    )
-                    vcount = int(counts[depth])
-                    vsum = sum((1 << i) * int(counts[i]) for i in range(depth))
-                    return ValCount(vsum + vcount * bsig.min, vcount)
-                bits, count = self.engine.bsi_val_count(
+                out = self.engine.bsi_val_count(
                     index, field_name, kind, depth, local_shards, filter_call
                 )
-                if count == 0:
-                    return ValCount()
-                from .ops.bitplane import compose_bits
-
-                return ValCount(compose_bits(bits) + bsig.min, count)
+                return self._compose_bsi_result(bsig, kind, out)
 
         if local_runner is not None:
             result = self._fan_out(index, shards, c, opt, local_runner, reduce_fn) or ValCount()
@@ -517,6 +580,35 @@ class Executor:
         if result.count == 0:
             return ValCount()
         return result
+
+    def _collective_val_count(self, index: str, field_name: str, bsig, kind: str,
+                              filter_call) -> ValCount:
+        """BSI Sum/Min/Max as ONE SPMD program over the global mesh — the
+        cluster-wide replacement for the per-node ValCount merge loop."""
+        out = self.collective.bsi_val_count(
+            index, field_name, kind, bsig.bit_depth(), filter_call
+        )
+        return self._compose_bsi_result(bsig, kind, out)
+
+    @staticmethod
+    def _compose_bsi_result(bsig, kind: str, out) -> ValCount:
+        """ValCount from a bsi_val_count result — ONE implementation of the
+        offset/weight math shared by the local-engine and collective
+        providers so the two paths cannot silently diverge."""
+        depth = bsig.bit_depth()
+        if kind == "sum":
+            counts = out
+            vcount = int(counts[depth])
+            if vcount == 0:
+                return ValCount()
+            vsum = sum((1 << i) * int(counts[i]) for i in range(depth))
+            return ValCount(vsum + vcount * bsig.min, vcount)
+        bits, count = out
+        if count == 0:
+            return ValCount()
+        from .ops.bitplane import compose_bits
+
+        return ValCount(compose_bits(bits) + bsig.min, count)
 
     def _execute_val_count_shard(self, index: str, c: Call, shard: int, kind: str) -> ValCount:
         filter_row = None
@@ -568,6 +660,39 @@ class Executor:
         ids = self._uint_slice_arg(c, "ids")
         tanimoto, _ = c.uint_arg("tanimotoThreshold")
         src_call = c.children[0] if c.children else None
+
+        if (
+            ids
+            and not c.args.get("attrName")
+            and not tanimoto
+            and max(c.uint_arg("threshold")[0], DEFAULT_MIN_THRESHOLD) <= 1
+            and (src_call is None or self.engine.supports(src_call))
+            and self._collective_ok(index, shards, opt)
+        ):
+            # Collective phase-2: global candidate counts in one SPMD
+            # program per chunk instead of an HTTP fan-out per node.
+            # Restricted to threshold<=1 (per-shard MinThreshold semantics
+            # need per-shard counts, fragment.go:899-990).
+            from .parallel.collective import CollectiveUnavailable
+
+            field_name = c.args.get("_field") or DEFAULT_FIELD
+            try:
+                pairs: List[Pair] = []
+                CHUNK = 512  # bounds the (R, S, W) global stack
+                for i in range(0, len(ids), CHUNK):
+                    chunk = ids[i : i + CHUNK]
+                    counts = self.collective.topn_counts(
+                        index, field_name, chunk, src_call
+                    )
+                    pairs.extend(
+                        Pair(id=r, count=int(cnt))
+                        for r, cnt in zip(chunk, counts)
+                        if cnt > 0
+                    )
+                self.holder.stats.count("CollectiveTopN", 1)
+                return sort_pairs(pairs)
+            except CollectiveUnavailable as e:
+                self._collective_fallback(e)
         if (
             ids
             and not c.args.get("attrName")

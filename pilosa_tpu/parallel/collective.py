@@ -1,0 +1,714 @@
+"""Generalized multi-host collective query execution.
+
+The reference fans every call type out over HTTP and reduces in Python
+(/root/reference/executor.go:1393-1440, 1464-1555). The TPU-native fast
+path replaces that reduce loop with ONE SPMD program over a global device
+mesh spanning every host's chips: each process feeds the shard planes it
+owns, XLA inserts ICI/DCN collectives for the reductions, and the
+all-reduced result materializes on every host.
+
+Design (round-4 redesign of the round-3 CollectiveWorker):
+
+- **Placement follows the cluster.** The leader derives each process's
+  shard list from the REAL jump-hash placement (cluster/hash.py, reference
+  cluster.go:776-857) and ships it in the descriptor; global array slots
+  are ordered by process so every process contributes exactly the
+  fragments it owns. Workers verify ownership of every assigned shard
+  against their own cluster view and refuse loudly on mismatch — the
+  round-3 block-contiguous layout silently counted unowned slots as zero.
+- **Any fast-path call tree.** The descriptor carries the PQL string of
+  the (already key-translated) call; every process compiles it with the
+  shared engine compiler (parallel/engine.py _Compiler), so any
+  Row/Intersect/Union/Difference/Xor/Range tree, TopN candidate counting,
+  and BSI Sum/Min/Max run collectively — not just Count(Intersect).
+- **Failure semantics.** Every process passes a named barrier (the
+  jax.distributed runtime's wait_at_barrier, with a timeout) BEFORE
+  entering the device program. A dead or lagging peer times the barrier
+  out everywhere; the leader falls back to the HTTP fan-out path and the
+  peers simply skip — nobody blocks forever inside an all-reduce.
+- **Total order.** Collective entry is serialized per process by a single
+  runner thread consuming descriptors in cluster-wide sequence order
+  (sequence numbers from the jax.distributed KV store's atomic increment),
+  so concurrent leaders cannot interleave SPMD programs differently on
+  different processes (deadlock/cross-wired results).
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..constants import VIEW_BSI_GROUP_PREFIX, WORDS_PER_ROW
+from ..errors import PilosaError, QueryError
+from .distributed import SHARD_AXIS, global_mesh
+
+DEFAULT_TIMEOUT_MS = int(os.environ.get("PILOSA_COLLECTIVE_TIMEOUT_MS", "10000"))
+_SPLIT = 0x7FFF  # 15-bit split keeps per-row sums exact without x64 (distributed._split_sum)
+
+
+class CollectiveUnavailable(PilosaError):
+    """The collective plane cannot (or must not) serve this request;
+    callers fall back to the HTTP fan-out path."""
+
+
+def _dist_client():
+    """The jax.distributed runtime client (barrier + KV store), or None
+    outside a multi-process job."""
+    try:
+        from jax._src import distributed as jdist
+
+        return jdist.global_state.client
+    except Exception:  # pragma: no cover - defensive against jax internals
+        return None
+
+
+def placement(cluster, index: str, n_shards: int, n_processes: int) -> List[List[int]]:
+    """Per-process shard lists from the REAL cluster placement.
+
+    Each shard goes to the process of its first available owner per
+    jump-hash (cluster.go:776-857). Raises CollectiveUnavailable when any
+    owning node's jax process index is unknown (node not in the job, or
+    membership status hasn't propagated yet)."""
+    slots: List[List[int]] = [[] for _ in range(n_processes)]
+    for s in range(n_shards):
+        owners = cluster.shard_nodes(index, s)
+        owner = next(
+            (n for n in owners if n.id not in cluster.unavailable), None
+        ) or (owners[0] if owners else None)
+        if owner is None:
+            raise CollectiveUnavailable(f"no owner for shard {s}")
+        p = owner.process_idx
+        if p is None or not (0 <= p < n_processes):
+            raise CollectiveUnavailable(
+                f"node {owner.id} has no known jax process index"
+            )
+        slots[p].append(s)
+    return slots
+
+
+class CollectiveBackend:
+    """Leader + peer sides of collective execution for one server process."""
+
+    def __init__(self, server):
+        self.server = server
+        self.holder = server.holder
+        self.logger = server.logger
+        self.timeout_ms = DEFAULT_TIMEOUT_MS
+        # Compiled-program cache, entry-bounded LRU: keys embed baked Range
+        # predicates, so varied predicates would otherwise pin one XLA
+        # executable each forever (same bound as engine.py's fn caches).
+        self._fn_cache: Dict[Tuple, object] = {}
+        self._fn_budget = int(os.environ.get("PILOSA_FN_CACHE_ENTRIES", 256))
+        self._leaf_cache: Dict[Tuple, Tuple[Tuple, object]] = {}
+        self._leaf_bytes = 0
+        self._leaf_budget = int(
+            os.environ.get("PILOSA_COLLECTIVE_LEAF_BYTES", 1 << 28)
+        )
+        self._lock = threading.Lock()
+        self._local_seq = 0
+        self._runner = _Runner(self)
+        # Descriptor broadcasts ride a shared pool: a thread per peer per
+        # query would churn on the hot path (every full-index query).
+        self._senders = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="collective-send"
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self) -> None:
+        self._runner.close()
+        self._senders.shutdown(wait=False)
+
+    def active(self) -> bool:
+        """True when a multi-process jax job spans the whole cluster — the
+        precondition for the collective plane to cover all data."""
+        import jax
+
+        n_proc = jax.process_count()
+        if n_proc <= 1:
+            return False
+        cluster = self.server.cluster
+        if cluster.unavailable:
+            # A down node can't reach the barrier; entering would stall
+            # every query the full barrier timeout before falling back.
+            # The failure detector already knows — fall back instantly.
+            return False
+        nodes = cluster.nodes
+        if len(nodes) != n_proc:
+            return False
+        return all(n.process_idx is not None for n in nodes)
+
+    # ---------------------------------------------------------- leader side
+
+    def count(self, index: str, call) -> int:
+        desc = self._descriptor(
+            "count", index, query=str(call), sig=self._call_sig(index, call)
+        )
+        lo, hi = self._lead(desc)
+        return (int(hi) << 15) + int(lo)
+
+    def topn_counts(self, index: str, field: str, row_ids: Sequence[int],
+                    src_call=None) -> np.ndarray:
+        """Global per-row counts (optionally ∩ src bitmap) — the distributed
+        TopN phase-2 inner loop, one SPMD program for the whole cluster."""
+        desc = self._descriptor(
+            "topn", index, field=field, rows=[int(r) for r in row_ids],
+            query=str(src_call) if src_call is not None else None,
+            sig=self._call_sig(index, src_call),
+        )
+        lo, hi = self._lead(desc)
+        return (np.asarray(hi).astype(np.int64) << 15) + np.asarray(lo)
+
+    def bsi_val_count(self, index: str, field: str, kind: str, depth: int,
+                      filter_call=None):
+        """Collective BSI Sum/Min/Max (fragment.go:565-837 bit-slice scans
+        over the global plane set). kind='sum' -> (depth+1,) per-plane
+        global counts; 'min'/'max' -> (bits, count)."""
+        desc = self._descriptor(
+            "bsi", index, field=field, bsi_kind=kind, depth=depth,
+            query=str(filter_call) if filter_call is not None else None,
+            sig=self._call_sig(index, filter_call),
+        )
+        out = self._lead(desc)
+        if kind == "sum":
+            lo, hi = out
+            return (np.asarray(hi).astype(np.int64) << 15) + np.asarray(lo)
+        bits, count = out
+        return np.asarray(bits), int(count)
+
+    def _call_sig(self, index: str, call) -> Optional[str]:
+        """Canonical structure signature of a compiled call. Shipped in the
+        descriptor so peers can detect schema divergence (a lagging bsig
+        depth/offset bakes DIFFERENT predicates into each side of the SPMD
+        program — silently wrong sums) and refuse instead of computing."""
+        if call is None:
+            return None
+        comp, _ = self._compile(index, call)
+        return repr(tuple(comp.signature))
+
+    def _descriptor(self, kind: str, index: str, query: Optional[str] = None,
+                    field: Optional[str] = None, rows: Optional[List[int]] = None,
+                    bsi_kind: Optional[str] = None, depth: Optional[int] = None,
+                    sig: Optional[str] = None) -> dict:
+        import jax
+
+        idx = self.holder.index(index)
+        if idx is None:
+            from ..errors import IndexNotFoundError
+
+            raise IndexNotFoundError(index)
+        n_shards = idx.max_shard() + 1
+        n_proc = jax.process_count()
+        if n_proc > 1:
+            if not self.active():
+                raise CollectiveUnavailable(
+                    "jax.distributed job does not span the cluster "
+                    f"({len(self.server.cluster.nodes)} nodes, {n_proc} processes)"
+                )
+            slots = placement(self.server.cluster, index, n_shards, n_proc)
+        else:
+            slots = [list(range(n_shards))]
+        d_local = jax.local_device_count()
+        k = max(max(len(s) for s in slots), 1)
+        k = ((k + d_local - 1) // d_local) * d_local
+        return {
+            "type": "collective-exec", "seq": self._next_seq(), "kind": kind,
+            "index": index, "query": query, "field": field, "rows": rows,
+            "bsiKind": bsi_kind, "depth": depth, "nShards": n_shards,
+            "slots": slots, "k": k, "timeoutMs": self.timeout_ms,
+            "sig": sig,
+        }
+
+    def _next_seq(self) -> int:
+        client = _dist_client()
+        if client is not None:
+            try:
+                return int(client.key_value_increment("pilosa-collective-seq", 1))
+            except Exception as e:
+                raise CollectiveUnavailable(f"seq allocation failed: {e}")
+        with self._lock:
+            self._local_seq += 1
+            return self._local_seq
+
+    def _lead(self, desc: dict):
+        """Broadcast the descriptor, enter locally, return the result.
+
+        The broadcast must not wait for peer responses (a peer blocks
+        inside the collective until every process enters), and any failure
+        surfaces as CollectiveUnavailable so the executor falls back to
+        the HTTP fan-out path."""
+        import jax
+
+        if jax.process_count() > 1:
+            for node in self.server.cluster.nodes:
+                if node.id == self.server.cluster.node.id:
+                    continue
+                self._senders.submit(self._send, node, desc)
+        fut = self._runner.submit(desc)
+        try:
+            return fut.result(timeout=desc["timeoutMs"] / 1000.0 + 30.0)
+        except CollectiveUnavailable:
+            raise
+        except Exception as e:
+            raise CollectiveUnavailable(f"collective execution failed: {e}")
+
+    def _send(self, node, desc: dict) -> None:
+        try:
+            self.server.client.send_message(node, desc)
+        except PilosaError as e:
+            # The peer misses the descriptor; the barrier times out and
+            # every process aborts cleanly instead of hanging.
+            self.logger.error("collective broadcast to %s failed: %s", node.id, e)
+
+    # ------------------------------------------------------------ peer side
+
+    def receive(self, desc: dict) -> None:
+        """Peer side of the broadcast: enqueue and return immediately (the
+        HTTP handler thread must not block inside the collective)."""
+        self._runner.submit(desc)
+
+    # ----------------------------------------------------------- execution
+
+    def _enter(self, desc: dict):
+        """Execute one descriptor. Called only from the runner thread, in
+        cluster-wide seq order."""
+        import jax
+
+        index = desc["index"]
+        n_proc = jax.process_count()
+        pid = jax.process_index()
+        slots = desc["slots"]
+        k = int(desc["k"])
+        if len(slots) != n_proc:
+            raise CollectiveUnavailable(
+                f"descriptor spans {len(slots)} processes, job has {n_proc}"
+            )
+        my_shards = [int(s) for s in slots[pid]]
+        if len(my_shards) > k:
+            raise CollectiveUnavailable("slot range overflow")
+        if n_proc > 1:
+            self._verify_ownership(index, my_shards)
+        mesh = global_mesh()
+        self._verify_mesh_layout(mesh, pid)
+        s_padded = n_proc * k
+
+        kind = desc["kind"]
+        call = None
+        if desc.get("query"):
+            from ..pql.parser import parse
+
+            call = parse(desc["query"]).calls[0]
+
+        if kind == "count":
+            return self._run_count(desc, index, call, my_shards, k, s_padded, mesh)
+        if kind == "topn":
+            return self._run_topn(desc, index, call, my_shards, k, s_padded, mesh)
+        if kind == "bsi":
+            return self._run_bsi(desc, index, call, my_shards, k, s_padded, mesh)
+        raise CollectiveUnavailable(f"unknown collective kind: {kind}")
+
+    def _verify_ownership(self, index: str, my_shards: List[int]) -> None:
+        """Refuse loudly when the leader's placement disagrees with this
+        node's cluster view — silently contributing zero planes for
+        unowned shards is a wrong count (ADVICE r3 high)."""
+        cluster = self.server.cluster
+        me = cluster.node.id
+        for s in my_shards:
+            if not cluster.owns_shard(me, index, s):
+                raise CollectiveUnavailable(
+                    f"placement mismatch: process assigned shard {s} of "
+                    f"{index!r} but node {me} does not own it"
+                )
+
+    @staticmethod
+    def _verify_mesh_layout(mesh, pid: int) -> None:
+        """make_array_from_process_local_data assumes this process's devices
+        hold the contiguous slot block [pid*k, (pid+1)*k); that holds only
+        when mesh device order is process-contiguous. Check, don't assume."""
+        devs = list(mesh.devices.flat)
+        mine = [i for i, d in enumerate(devs) if d.process_index == pid]
+        if not mine:
+            raise CollectiveUnavailable(
+                "this process owns no devices in the global mesh"
+            )
+        if mine != list(range(pid * len(mine), (pid + 1) * len(mine))):
+            raise CollectiveUnavailable(
+                "global device order is not process-contiguous; "
+                "collective slot layout would misplace shards"
+            )
+
+    def _barrier(self, desc: dict) -> None:
+        import jax
+
+        if jax.process_count() <= 1:
+            return
+        client = _dist_client()
+        if client is None:
+            raise CollectiveUnavailable("no distributed runtime client")
+        try:
+            client.wait_at_barrier(
+                f"pilosa-collective-{desc['seq']}", int(desc["timeoutMs"])
+            )
+        except Exception as e:
+            raise CollectiveUnavailable(
+                f"collective barrier timed out (seq {desc['seq']}): {e}"
+            )
+
+    # ------------------------------------------------------- plane assembly
+
+    def _local_block(self, index: str, leaf, my_shards: List[int], k: int) -> np.ndarray:
+        buf = np.zeros((k, WORDS_PER_ROW), dtype=np.uint32)
+        for i, s in enumerate(my_shards):
+            frag = self.holder.fragment(index, leaf.field, leaf.view, s)
+            if frag is not None:
+                buf[i] = frag.plane_np(leaf.row)
+        return buf
+
+    def _leaf_fingerprint(self, index: str, leaf, my_shards: List[int]) -> Tuple:
+        return tuple(
+            -1 if f is None else f.generation
+            for f in (
+                self.holder.fragment(index, leaf.field, leaf.view, s)
+                for s in my_shards
+            )
+        )
+
+    def _global_leaf(self, index: str, leaf, my_shards: List[int], k: int,
+                     s_padded: int, mesh):
+        """(S_padded, W) global array for one leaf; cached per process and
+        invalidated by this process's OWN fragment generations (each
+        process's buffers are local, so staleness is a local property)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (index, leaf, tuple(my_shards), k, s_padded)
+        fp = self._leaf_fingerprint(index, leaf, my_shards)
+        with self._lock:
+            cached = self._leaf_cache.get(key)
+            if cached is not None and cached[0] == fp:
+                self._leaf_cache[key] = self._leaf_cache.pop(key)  # LRU touch
+                return cached[1]
+        block = self._local_block(index, leaf, my_shards, k)
+        sharding = NamedSharding(mesh, P(SHARD_AXIS, None))
+        arr = jax.make_array_from_process_local_data(
+            sharding, block, (s_padded, WORDS_PER_ROW)
+        )
+        with self._lock:
+            prev = self._leaf_cache.pop(key, None)
+            if prev is not None:
+                self._leaf_bytes -= prev[1].nbytes
+            self._leaf_cache[key] = (fp, arr)
+            self._leaf_bytes += arr.nbytes
+            while self._leaf_bytes > self._leaf_budget and len(self._leaf_cache) > 1:
+                old_key = next(iter(self._leaf_cache))
+                if old_key == key:
+                    break
+                self._leaf_bytes -= self._leaf_cache.pop(old_key)[1].nbytes
+        return arr
+
+    def _global_stack(self, index: str, leaves, my_shards: List[int], k: int,
+                      s_padded: int, mesh):
+        """(L, S_padded, W) global array for a leaf stack (TopN rows, BSI
+        planes). Gathered fresh: candidate sets vary per query."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        block = np.stack(
+            [self._local_block(index, leaf, my_shards, k) for leaf in leaves]
+        )
+        sharding = NamedSharding(mesh, P(None, SHARD_AXIS, None))
+        return jax.make_array_from_process_local_data(
+            sharding, block, (len(leaves), s_padded, WORDS_PER_ROW)
+        )
+
+    def _compile(self, index: str, call):
+        from .engine import _Compiler
+
+        comp = _Compiler(self.holder, index)
+        expr = comp.compile(call)
+        return comp, expr
+
+    def _fn(self, key: Tuple, build):
+        with self._lock:
+            fn = self._fn_cache.get(key)
+            if fn is not None:
+                self._fn_cache[key] = self._fn_cache.pop(key)  # LRU touch
+        if fn is None:
+            fn = build()
+            with self._lock:
+                self._fn_cache[key] = fn
+                while len(self._fn_cache) > self._fn_budget:
+                    self._fn_cache.pop(next(iter(self._fn_cache)))
+        return fn
+
+    # -------------------------------------------------------- program kinds
+
+    def _check_sig(self, desc, comp) -> None:
+        """Refuse when this process compiled a different program structure
+        than the leader (schema divergence: a lagging bsig depth/offset
+        bakes different predicates into each side of the SPMD program)."""
+        want = desc.get("sig")
+        if want is not None and repr(tuple(comp.signature)) != want:
+            raise CollectiveUnavailable(
+                "schema divergence: local call signature "
+                f"{tuple(comp.signature)!r} != leader's {want}"
+            )
+
+    def _run_count(self, desc, index, call, my_shards, k, s_padded, mesh):
+        import jax
+        import jax.numpy as jnp
+
+        comp, expr = self._compile(index, call)
+        self._check_sig(desc, comp)
+        leaves = tuple(
+            self._global_leaf(index, leaf, my_shards, k, s_padded, mesh)
+            for leaf in comp.leaves
+        )
+        sig = ("count", tuple(comp.signature), s_padded)
+
+        def build():
+            @jax.jit
+            def fn(lv):
+                pc = jax.lax.population_count(expr(lv)).astype(jnp.int32)
+                per = jnp.sum(pc, axis=1)  # (S,) partials, each <= 2^20
+                return jnp.sum(per & _SPLIT), jnp.sum(per >> 15)
+
+            return fn
+
+        fn = self._fn(sig, build)
+        self._barrier(desc)
+        lo, hi = fn(leaves)
+        return int(lo), int(hi)
+
+    def _run_topn(self, desc, index, call, my_shards, k, s_padded, mesh):
+        import jax
+        import jax.numpy as jnp
+
+        from .engine import Leaf
+        from ..constants import VIEW_STANDARD
+
+        field = desc["field"]
+        rows = [int(r) for r in desc["rows"]]
+        leaves = [Leaf(field, VIEW_STANDARD, r) for r in rows]
+        stacked = self._global_stack(index, leaves, my_shards, k, s_padded, mesh)
+        src_leaves = None
+        fsig = ()
+        expr = None
+        if call is not None:
+            comp, expr = self._compile(index, call)
+            self._check_sig(desc, comp)
+            src_leaves = tuple(
+                self._global_leaf(index, leaf, my_shards, k, s_padded, mesh)
+                for leaf in comp.leaves
+            )
+            fsig = tuple(comp.signature)
+        sig = ("topn", fsig, len(rows), s_padded)
+
+        def build():
+            @jax.jit
+            def fn(stacked, src_lv):
+                x = stacked
+                if expr is not None:
+                    x = jnp.bitwise_and(x, expr(src_lv)[None])
+                pc = jax.lax.population_count(x).astype(jnp.int32)
+                per = jnp.sum(pc, axis=2)  # (R, S)
+                return jnp.sum(per & _SPLIT, axis=1), jnp.sum(per >> 15, axis=1)
+
+            return fn
+
+        fn = self._fn(sig, build)
+        self._barrier(desc)
+        lo, hi = fn(stacked, src_leaves)
+        return np.asarray(lo), np.asarray(hi)
+
+    def _run_bsi(self, desc, index, call, my_shards, k, s_padded, mesh):
+        import jax
+        import jax.numpy as jnp
+
+        from .engine import Leaf
+
+        field = desc["field"]
+        depth = int(desc["depth"])
+        kind = desc["bsiKind"]
+        # The plane layout itself depends on the bsig depth: a peer whose
+        # depth disagrees would read its bit-i planes as different
+        # magnitudes than the leader. Verify, don't assume.
+        fld = self.holder.field(index, field)
+        bsig = fld.bsi_group(field) if fld is not None else None
+        if bsig is None or bsig.bit_depth() != depth:
+            local = "missing" if bsig is None else bsig.bit_depth()
+            raise CollectiveUnavailable(
+                f"schema divergence: bsig depth for {field!r} is {local}, "
+                f"leader says {depth}"
+            )
+        view = VIEW_BSI_GROUP_PREFIX + field
+        leaves = [Leaf(field, view, i) for i in range(depth + 1)]
+        planes = self._global_stack(index, leaves, my_shards, k, s_padded, mesh)
+        filter_leaves = None
+        fsig = ()
+        expr = None
+        if call is not None:
+            comp, expr = self._compile(index, call)
+            self._check_sig(desc, comp)
+            filter_leaves = tuple(
+                self._global_leaf(index, leaf, my_shards, k, s_padded, mesh)
+                for leaf in comp.leaves
+            )
+            fsig = tuple(comp.signature)
+        sig = ("bsi", kind, depth, fsig, s_padded)
+
+        def build():
+            def total(x):
+                pc = jax.lax.population_count(x).astype(jnp.int32)
+                per = jnp.sum(pc, axis=-1)
+                return jnp.sum(per)
+
+            if kind == "sum":
+                @jax.jit
+                def fn(planes, flt):
+                    x = planes
+                    if expr is not None:
+                        x = jnp.bitwise_and(x, expr(flt)[None])
+                    pc = jax.lax.population_count(x).astype(jnp.int32)
+                    per = jnp.sum(pc, axis=2)  # (D+1, S)
+                    return (
+                        jnp.sum(per & _SPLIT, axis=1),
+                        jnp.sum(per >> 15, axis=1),
+                    )
+            else:
+                maximize = kind == "max"
+
+                @jax.jit
+                def fn(planes, flt):
+                    consider = planes[depth]
+                    if expr is not None:
+                        consider = jnp.bitwise_and(consider, expr(flt))
+                    bits = []
+                    for i in range(depth - 1, -1, -1):
+                        if maximize:
+                            x = jnp.bitwise_and(planes[i], consider)
+                        else:
+                            x = jnp.bitwise_and(consider, jnp.bitwise_not(planes[i]))
+                        nonzero = total(x) > 0
+                        bit = (
+                            jnp.where(nonzero, 1, 0)
+                            if maximize
+                            else jnp.where(nonzero, 0, 1)
+                        )
+                        bits.append(bit.astype(jnp.int32))
+                        consider = jnp.where(nonzero, x, consider)
+                    bits = (
+                        jnp.stack(bits[::-1])
+                        if bits
+                        else jnp.zeros((0,), jnp.int32)
+                    )
+                    return bits, total(consider)
+
+            return fn
+
+        fn = self._fn(sig, build)
+        self._barrier(desc)
+        out = fn(planes, filter_leaves)
+        if kind == "sum":
+            lo, hi = out
+            return np.asarray(lo), np.asarray(hi)
+        bits, count = out
+        return np.asarray(bits), int(count)
+
+
+class _Runner:
+    """Single consumer thread executing descriptors in cluster-wide seq
+    order. Seqs are dense except when a leader dies between allocating a
+    seq and broadcasting it; a bounded gap wait keeps a dead leader from
+    stalling the queue (its own peers' barrier times out regardless)."""
+
+    GAP_TIMEOUT = 2.0
+
+    def __init__(self, backend: CollectiveBackend):
+        self.backend = backend
+        self._heap: List[Tuple[int, int, dict, Future]] = []
+        self._tiebreak = 0
+        self._cond = threading.Condition()
+        self._last_seq = 0
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, desc: dict) -> Future:
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                fut.set_exception(CollectiveUnavailable("collective runner closed"))
+                return fut
+            self._tiebreak += 1
+            heapq.heappush(
+                self._heap, (int(desc["seq"]), self._tiebreak, desc, fut)
+            )
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="collective-runner", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify_all()
+        return fut
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._heap and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    for _, _, _, fut in self._heap:
+                        if not fut.done():
+                            fut.set_exception(
+                                CollectiveUnavailable("collective runner closed")
+                            )
+                    self._heap.clear()
+                    return
+                # In-order delivery: wait (bounded) for a missing seq so all
+                # processes execute collectives in the same order.
+                deadline = time.monotonic() + self.GAP_TIMEOUT
+                while (
+                    self._heap
+                    and self._heap[0][0] > self._last_seq + 1
+                    and not self._closed
+                ):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                if not self._heap:
+                    continue
+                seq, _, desc, fut = heapq.heappop(self._heap)
+                if seq <= self._last_seq:
+                    # A gap-skipped descriptor arrived late: its other
+                    # participants already timed out at its barrier, and
+                    # entering it now would both stall this runner for the
+                    # full barrier timeout and break the same-order
+                    # invariant. Reject, never execute.
+                    fut.set_exception(CollectiveUnavailable(
+                        f"stale collective seq {seq} (already past "
+                        f"{self._last_seq})"
+                    ))
+                    continue
+                self._last_seq = seq
+            try:
+                result = self.backend._enter(desc)
+            except BaseException as e:
+                if not fut.done():
+                    fut.set_exception(e)
+                continue
+            if not fut.done():
+                fut.set_result(result)

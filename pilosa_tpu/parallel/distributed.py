@@ -149,39 +149,10 @@ def global_and_count(planes_a, planes_b) -> int:
     return (int(hi) << 15) + int(lo)
 
 
-class CollectiveWorker:
-    """Non-leader side of leader-driven collective serving.
-
-    The leader broadcasts {"type": "collective-count", "index", "field",
-    "rows", "n_shards"} on the cluster plane; every node (leader included)
-    then calls `enter` with its local planes. All processes run the same
-    program; the count materializes everywhere."""
-
-    def __init__(self, holder):
-        self.holder = holder
-
-    def enter(self, index: str, field: str, rows: Sequence[int],
-              n_shards: int) -> int:
-        from ..constants import SHARD_WIDTH
-
-        if not rows:
-            raise ValueError("collective count requires at least one row")
-
-        padded, lo, hi = process_shard_slots(n_shards)
-        w = SHARD_WIDTH // 32
-        blocks = []
-        for row in rows:
-            block = np.zeros((hi - lo, w), dtype=np.uint32)
-            for slot in range(lo, min(hi, n_shards)):
-                frag = self.holder.fragment(index, field, "standard", slot)
-                if frag is not None:
-                    block[slot - lo] = frag.plane_np(row)
-            blocks.append(make_global_planes(block, padded))
-        if len(blocks) == 1:
-            return global_count(blocks[0])
-        import jax.numpy as jnp
-
-        acc = blocks[0]
-        for nxt in blocks[1:]:
-            acc = jnp.bitwise_and(acc, nxt)
-        return global_count(acc)
+# NOTE: the round-3 CollectiveWorker lived here. It assumed block-contiguous
+# slot->process placement, which contradicts the cluster's jump-hash
+# placement and silently counted unowned slots as zeros. The production
+# collective plane is parallel/collective.py (placement follows jump-hash,
+# workers verify ownership, entry is barrier-guarded and seq-ordered). The
+# low-level helpers above remain for hand-assembled plane blocks (tests,
+# benchmarks).

@@ -24,7 +24,15 @@ SHARD_AXIS = "shards"
 
 
 def default_mesh(devices: Optional[Sequence] = None) -> Mesh:
-    devices = list(devices if devices is not None else jax.devices())
+    """1-D mesh over this process's LOCAL devices.
+
+    Local, not global: the per-node engine's programs are entered by this
+    process alone (per-shard fan-out hands each node its own shards), and
+    a program sharded over other processes' devices would block inside the
+    runtime waiting for peers that never enter it. The multi-host global
+    mesh belongs exclusively to the collective plane, where every process
+    enters together (parallel/collective.py)."""
+    devices = list(devices if devices is not None else jax.local_devices())
     return Mesh(np.array(devices), (SHARD_AXIS,))
 
 

@@ -306,6 +306,10 @@ class API:
             # dedicated gossip plane.
             "maxShards": self.shards_max(),
             "schema": self.holder.schema(),
+            # jax.distributed identity rides the status probe so static
+            # clusters converge on every node's process index (the
+            # collective plane's placement needs all of them).
+            "processIdx": self.cluster.node.process_idx,
         }
 
     def info(self) -> dict:
@@ -351,21 +355,12 @@ class API:
         return {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()}
 
     def collective_count(self, index: str, field: str, rows: List[int]) -> int:
-        """Leader side of multi-host collective execution: broadcast the
-        query descriptor so every jax.distributed process enters the same
-        global-mesh program, then enter it locally. The all-reduced count
-        (Intersect over `rows`) materializes on every host; the leader
-        answers. Degenerates to a local device count on single-process
-        jobs (parallel/distributed.py).
-
-        The broadcast must NOT wait for peer responses: a peer's message
-        handler blocks inside the collective until every process (this
-        leader included) has entered, so a synchronous broadcast would
-        deadlock leader-waiting-on-peer-waiting-on-leader."""
-        import threading
-
-        from ..parallel.distributed import CollectiveWorker
-
+        """Leader side of multi-host collective execution: Count(Intersect)
+        over `rows` through the generalized collective backend
+        (parallel/collective.py) — placement follows jump-hash, entry is
+        barrier-guarded and seq-ordered, failures surface instead of
+        hanging. Degenerates to a local device count on single-process
+        jobs."""
         self._validate("collective_count")
         if not rows:
             raise QueryError("collective_count requires at least one row")
@@ -382,29 +377,12 @@ class API:
                     f"{jax.process_count()} jax processes); "
                     "set PILOSA_JAX_COORDINATOR on every node"
                 )
-        idx = self.holder.index(index)
-        if idx is None:
-            from ..errors import IndexNotFoundError
+        from ..pql.parser import parse
 
-            raise IndexNotFoundError(index)
-        n_shards = idx.max_shard() + 1
-        msg = {
-            "type": "collective-count", "index": index, "field": field,
-            "rows": list(rows), "nShards": n_shards,
-        }
-        def send(node):
-            try:
-                self.server.client.send_message(node, msg)
-            except PilosaError as e:
-                self.server.logger.error(
-                    "collective broadcast to %s failed: %s", node.id, e
-                )
-
-        for node in self.cluster.nodes:
-            if node.id == self.cluster.node.id:
-                continue
-            threading.Thread(target=send, args=(node,), daemon=True).start()
-        return CollectiveWorker(self.holder).enter(index, field, rows, n_shards)
+        terms = ", ".join(f"Row({field}={int(r)})" for r in rows)
+        query = terms if len(rows) == 1 else f"Intersect({terms})"
+        call = parse(query).calls[0]
+        return self.server.collective.count(index, call)
 
     def cluster_message(self, msg: dict) -> None:
         self._validate("cluster_message")

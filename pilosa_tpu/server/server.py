@@ -131,6 +131,7 @@ class Server:
             logger=self.logger,
         )
         self.resize_coordinator = None  # set on demand by coordinators
+        self.collective = None  # CollectiveBackend, constructed in open()
         self._httpd = None
         self._http_thread = None
         self._join_lock = threading.Lock()  # admission may race solicit vs HTTP
@@ -178,10 +179,18 @@ class Server:
         if distributed.initialize():
             import jax
 
+            self.node.process_idx = jax.process_index()
             self.logger.info(
                 "joined jax.distributed job: process %d/%d, %d global devices",
                 jax.process_index(), jax.process_count(), jax.device_count(),
             )
+        # Collective query plane (leader + peer sides). Constructed for
+        # every server — single-process jobs degenerate to the local mesh.
+        from ..parallel.collective import CollectiveBackend
+
+        self.collective = CollectiveBackend(self)
+        self.executor.collective = self.collective
+        self.executor.logger = self.logger
         self.translate_store.open()
         self._httpd, self._http_thread, actual_port = serve(
             self.handler, self.host, self.port, ssl_context=self._ssl_context()
@@ -439,6 +448,8 @@ class Server:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        if self.collective is not None:
+            self.collective.close()
         self.holder.close()
         self.translate_store.close()
         self.opened = False
@@ -542,6 +553,11 @@ class Server:
                     idx = self.holder.index(index_name)
                     if idx is not None:
                         idx.set_remote_max_shard(max_shard)
+                # The peer's jax process index rides its status (static
+                # clusters build peer Nodes from config, which can't know
+                # it); the collective plane needs every node's index.
+                if status.get("processIdx") is not None:
+                    node.process_idx = status["processIdx"]
                 # A probed peer reporting STARTING without us in its node
                 # list is a restarted coordinator waiting on topology
                 # quorum: re-send node-join so it can count us (the
@@ -627,6 +643,12 @@ class Server:
             prev_state = self.cluster.state
             self.cluster.state = msg.get("state", self.cluster.state)
             self.cluster.nodes = [Node.from_dict(n) for n in msg.get("nodes", [])]
+            for n in self.cluster.nodes:
+                # Our own jax process index is authoritative locally; a
+                # status assembled before our join reported it would
+                # otherwise erase it from the membership view.
+                if n.id == self.node.id and n.process_idx is None:
+                    n.process_idx = self.node.process_idx
             if self.cluster.state == STATE_NORMAL:
                 # Only NORMAL membership is checkpointed: a STARTING status
                 # carries partial membership and must not clobber the
@@ -663,16 +685,12 @@ class Server:
             self.handle_node_join(Node.from_dict(msg["node"]))
         elif typ == "node-leave":
             self.handle_node_leave(msg["nodeID"])
-        elif typ == "collective-count":
-            # Non-leader side of leader-driven collective serving: enter the
-            # same global-mesh program as the broadcasting leader (SPMD
-            # requires every process to participate; see
-            # parallel/distributed.py CollectiveWorker).
-            from ..parallel.distributed import CollectiveWorker
-
-            CollectiveWorker(self.holder).enter(
-                msg["index"], msg["field"], msg["rows"], msg["nShards"]
-            )
+        elif typ == "collective-exec":
+            # Non-leader side of leader-driven collective serving: enqueue
+            # the descriptor for the runner thread (SPMD entry happens in
+            # cluster-wide seq order; the handler thread must not block
+            # inside the collective). See parallel/collective.py.
+            self.collective.receive(msg)
         elif typ == "node-state":
             pass  # coordinator bookkeeping; static clusters are always NORMAL
         else:
