@@ -9,20 +9,30 @@ a sub-millisecond window, groups queries with identical call structure,
 and executes each group as ONE batched program via
 ShardedQueryEngine.count_batch — N queries, one dispatch.
 
-Latency math: a query pays at most `window` extra wait; with dispatch RTT
->> window (tens of ms through a TPU runtime vs 1ms window) batching wins
-whenever 2+ queries overlap, and a lone query pays only the window.
+WHEN batching helps is transport-dependent, so the coalescer is adaptive
+(round-3 BENCH showed a 2.6x serving REGRESSION on a remote-runtime link):
 
-Batches are also capped at `max_inflight` outstanding device round trips:
-result transfers serialize on the host<->device link, so once the link is
-saturated, dispatching another small batch only adds a full RTT — blocking
-the collector instead lets the next batch grow to the arrival rate times
-the RTT (batch-to-the-bandwidth-delay-product), which is exactly the batch
-size that keeps the link busy with the fewest round trips.
+- **Local device** (dispatch overhead ~100us of host work per call):
+  batching N queries into one program divides the per-call overhead by N.
+  This is the regime the window exists for.
+- **Remote runtime** (axon tunnel: ~70ms RTT per blocking call, transfers
+  serialize): N independent blocking clients already pipeline N RTTs, and
+  funneling them through one collector serializes what was parallel. The
+  coalescer measures the dispatch RTT once at startup (a trivial jitted
+  op, timed after warmup) and BYPASSES the window when RTT exceeds
+  `PILOSA_COALESCE_RTT_BYPASS` (default 10ms) — queries go straight to
+  the engine, which still serves repeats from its result memo.
+- **Idle traffic**: even on a local device, batching needs overlap. The
+  collector tracks an arrival-interval EWMA and bypasses when the
+  expected number of queries per dispatch (arrival_rate x dispatch cost)
+  is below ~2 — a lone query should not pay the window.
+
+`PILOSA_COALESCE_FORCE=1` pins batching on (tests, benchmarks).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -33,14 +43,22 @@ import numpy as np
 
 class QueryCoalescer:
     def __init__(self, engine, window: float = 0.001, max_batch: int = 256,
-                 max_inflight: int = None):
+                 max_inflight: int = None, rtt_bypass: float = None,
+                 force: bool = None):
         if max_inflight is None:
-            import os
-
             max_inflight = int(os.environ.get("PILOSA_COALESCE_INFLIGHT", "4"))
+        if rtt_bypass is None:
+            rtt_bypass = float(
+                os.environ.get("PILOSA_COALESCE_RTT_BYPASS", "0.010")
+            )
         self.engine = engine
         self.window = window
         self.max_batch = max_batch
+        self.rtt_bypass = rtt_bypass
+        self.force = (
+            force if force is not None
+            else os.environ.get("PILOSA_COALESCE_FORCE") == "1"
+        )
         self._cond = threading.Condition()
         self._pending: List[Tuple] = []
         self._closed = False
@@ -55,11 +73,78 @@ class QueryCoalescer:
         )
         self.batches_executed = 0
         self.queries_batched = 0
+        self.bypassed = 0
+        # Dispatch RTT, measured lazily on first use (compiling the probe at
+        # construction would stall server open on a remote runtime).
+        self.rtt: float = None
+        self._rtt_lock = threading.Lock()
+        # Arrival-interval EWMA (seconds); seeded pessimistic-slow so a
+        # burst must actually arrive before batching engages.
+        self._ewma_dt = 1.0
+        self._last_arrival = None
+
+    # ------------------------------------------------------------- adaptive
+
+    def _measure_rtt(self) -> float:
+        """Median blocking round trip of a trivial device op (timed after
+        compile+warmup). ~100us on a locally-attached backend, tens of ms
+        through a remote-runtime tunnel."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = jax.jit(lambda x: x + 1)
+        x = jnp.zeros(8, jnp.int32)
+        np.asarray(fn(x))  # compile + warm
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(fn(x))
+            samples.append(time.perf_counter() - t0)
+        return sorted(samples)[len(samples) // 2]
+
+    def _dispatch_rtt(self) -> float:
+        if self.rtt is None:
+            with self._rtt_lock:
+                if self.rtt is None:
+                    try:
+                        self.rtt = self._measure_rtt()
+                    except Exception:
+                        self.rtt = 0.0  # measurement failure: assume local
+        return self.rtt
+
+    def _note_arrival(self) -> None:
+        now = time.monotonic()
+        if self._last_arrival is not None:
+            dt = now - self._last_arrival
+            self._ewma_dt = 0.8 * self._ewma_dt + 0.2 * min(dt, 1.0)
+        self._last_arrival = now
+
+    def _should_batch(self) -> bool:
+        if self.force:
+            return True
+        rtt = self._dispatch_rtt()
+        if rtt > self.rtt_bypass:
+            # Remote-runtime regime: blocking clients already pipeline
+            # their own RTTs; the collector would serialize them.
+            return False
+        # Local regime: batch only when arrivals actually overlap a
+        # dispatch (expected queries per dispatch >= 2). The dispatch cost
+        # floor keeps the estimate sane when rtt measures ~0.
+        dispatch = max(rtt, 200e-6)
+        return dispatch / max(self._ewma_dt, 1e-9) >= 2.0
 
     # ---------------------------------------------------------------- API
 
     def count(self, index: str, call, shards: Sequence[int]) -> int:
-        """Blocking count; internally batched with concurrent callers."""
+        """Blocking count; batched with concurrent callers when the
+        transport regime favors it, direct to the engine otherwise."""
+        self._note_arrival()
+        if not self._should_batch():
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("coalescer closed")
+            self.bypassed += 1
+            return self.engine.count(index, call, shards)
         fut: Future = Future()
         with self._cond:
             if self._closed:
@@ -109,16 +194,22 @@ class QueryCoalescer:
         # Group by (index, call structure, shard set): count_batch requires
         # structural identity. Compilation happens once here and is passed
         # through to the engine (no second AST walk on the hot path).
+        # Queries already answered by the engine's result memo complete
+        # immediately without joining a device batch.
         groups: Dict[Tuple, List[Tuple]] = {}
         for item in batch:
             index, call, shards, fut = item
             try:
                 comp_expr = self.engine._compile(index, call)
+                hit, token = self.engine.memo_probe(index, comp_expr[0], shards)
+                if hit is not None:
+                    fut.set_result(hit)
+                    continue
                 key = (index, tuple(comp_expr[0].signature), shards)
             except Exception as e:
                 fut.set_exception(e)
                 continue
-            groups.setdefault(key, []).append(item + (comp_expr,))
+            groups.setdefault(key, []).append(item + (comp_expr, token))
 
         # Dispatch every group async (the device pipeline stays full), then
         # hand materialization to the finisher pool so the collector starts
@@ -152,6 +243,10 @@ class QueryCoalescer:
             counts = np.asarray(out).reshape(-1)
             for it, n in zip(items, counts[: len(items)]):
                 it[3].set_result(int(n))
+                # Feed the result memo with the PROBE-TIME token so a write
+                # that landed mid-flight invalidates rather than getting a
+                # stale count stamped with its own generation.
+                self.engine.memo_store(it[5], int(n))
         except Exception as e:
             for it in items:
                 if not it[3].done():

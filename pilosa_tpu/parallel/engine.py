@@ -184,11 +184,20 @@ class ShardedQueryEngine:
         # concurrency. One lock guards dict + byte-counter state; device work
         # (gather, device_put, jit) happens outside it.
         self._lock = threading.RLock()
+        # Host-side hot-query result memo: (index, structure signature,
+        # leaves, shards) -> (generation fingerprint, count). A repeat query
+        # whose fragments haven't changed skips the device round trip
+        # entirely — O(dict lookup + generation check) instead of O(RTT),
+        # which on a remote-runtime link is ~70ms -> ~50us. Invalidated by
+        # the same per-fragment generation counters as the leaf cache.
+        self._memo: Dict[Tuple, Tuple[Tuple, int]] = {}
+        self._memo_budget = int(os.environ.get("PILOSA_MEMO_ENTRIES", 8192))
         # Observable cache behavior (hit rate / eviction pressure) for
         # /debug/vars and the HBM-budget bench stanza.
         self.counters = {
             "leaf_hits": 0, "leaf_misses": 0, "leaf_evictions": 0,
             "stack_hits": 0, "stack_misses": 0, "stack_evictions": 0,
+            "memo_hits": 0, "memo_misses": 0,
         }
 
     # ------------------------------------------------------------ caches
@@ -383,6 +392,40 @@ class ShardedQueryEngine:
             self._release(("stack", key))
         return stacked
 
+    # ----------------------------------------------------------- query memo
+
+    def memo_probe(self, index: str, comp: "_Compiler",
+                   shards: Tuple[int, ...]):
+        """(memoized count or None, store token) for an already-compiled
+        call. A hit is host-only work (dict lookup + generation check).
+
+        The token freezes the generation fingerprint AT PROBE TIME — i.e.
+        before the query executes. memo_store(token) must use it, not a
+        fresh fingerprint: a write landing during the device round trip
+        bumps generations, and stamping the post-write generation onto the
+        pre-write count would serve stale results forever. With the probe-
+        time fingerprint the entry just misses on the next probe (the safe
+        direction, matching the leaf cache's fp-before-read ordering)."""
+        key = (index, tuple(comp.signature), tuple(comp.leaves), shards)
+        fp = tuple(self._fingerprint(index, leaf, shards) for leaf in comp.leaves)
+        token = (key, fp)
+        with self._lock:
+            ent = self._memo.get(key)
+            if ent is not None and ent[0] == fp:
+                self._memo[key] = self._memo.pop(key)  # LRU touch
+                self.counters["memo_hits"] += 1
+                return ent[1], token
+            self.counters["memo_misses"] += 1
+        return None, token
+
+    def memo_store(self, token, count: int) -> None:
+        key, fp = token
+        with self._lock:
+            self._memo.pop(key, None)
+            self._memo[key] = (fp, count)
+            while len(self._memo) > self._memo_budget:
+                self._memo.pop(next(iter(self._memo)))
+
     # -------------------------------------------------------------- queries
 
     def _compile(self, index: str, call: Call):
@@ -394,6 +437,9 @@ class ShardedQueryEngine:
         """Count(<bitmap call>) over all shards in one device program."""
         shards = tuple(shards)
         comp, expr = self._compile(index, call)
+        hit, token = self.memo_probe(index, comp, shards)
+        if hit is not None:
+            return hit
         sig = ("count", tuple(comp.signature), len(shards))
 
         def build():
@@ -408,7 +454,9 @@ class ShardedQueryEngine:
 
         fn = self._fn_build(self._count_fns, sig, build)
         leaves = self._leaf_tensor(index, comp.leaves, shards)
-        return int(fn(leaves))
+        result = int(fn(leaves))
+        self.memo_store(token, result)
+        return result
 
     def count_async(self, index: str, call: Call, shards: Sequence[int],
                     comp_expr=None):
@@ -439,8 +487,30 @@ class ShardedQueryEngine:
         unchanged to each query's leaf set; XLA fuses the whole batch and the
         host pays one dispatch + one transfer for Q results. This is the
         throughput-serving path (amortizes host<->device latency that caps
-        per-call serving at ~1/RTT)."""
-        return np.asarray(self.count_batch_async(index, calls, shards))[: len(calls)]
+        per-call serving at ~1/RTT). Queries answered by the result memo
+        skip the device entirely; only misses ride the batched program."""
+        shards = tuple(shards)
+        comps = [self._compile(index, c) for c in calls]
+        out = np.empty(len(calls), dtype=np.int64)
+        miss = []
+        tokens = {}
+        for i, (comp, _) in enumerate(comps):
+            hit, tokens[i] = self.memo_probe(index, comp, shards)
+            if hit is None:
+                miss.append(i)
+            else:
+                out[i] = hit
+        if miss:
+            res = np.asarray(
+                self.count_batch_async(
+                    index, [calls[i] for i in miss], shards,
+                    comps=[comps[i] for i in miss],
+                )
+            )[: len(miss)]
+            for j, i in enumerate(miss):
+                out[i] = int(res[j])
+                self.memo_store(tokens[i], int(res[j]))
+        return out
 
     def count_batch_async(self, index: str, calls: Sequence[Call],
                           shards: Sequence[int], comps=None) -> jax.Array:
