@@ -44,6 +44,10 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--long-query-time", dest="long_query_time", type=float)
     p.add_argument("--query-coalesce-window", dest="query_coalesce_window", type=float)
     p.add_argument("--anti-entropy-interval", dest="anti_entropy_interval", type=float)
+    p.add_argument("--gossip-probe-interval", dest="gossip_probe_interval", type=float)
+    p.add_argument("--gossip-probe-timeout", dest="gossip_probe_timeout", type=float)
+    p.add_argument("--gossip-key", dest="gossip_key",
+                   help="path to cluster shared-secret file")
     p.add_argument("--translation-primary-url", dest="translation_primary_url")
     p.add_argument("--tls-certificate", dest="tls_certificate")
     p.add_argument("--tls-certificate-key", dest="tls_certificate_key")
@@ -78,10 +82,19 @@ def cmd_server(args) -> int:
     return 0
 
 
-def cmd_import(args) -> int:
-    from .server.client import InternalClient
+def _ctl_client(args):
+    """InternalClient for ctl subcommands, carrying the cluster shared
+    secret when the target cluster is keyed (--gossip-key, same flag and
+    file format as the server)."""
+    from .server.client import InternalClient, load_cluster_key
 
-    client = InternalClient()
+    path = getattr(args, "gossip_key", None)
+    key = load_cluster_key(path) if path else None
+    return InternalClient(key=key)
+
+
+def cmd_import(args) -> int:
+    client = _ctl_client(args)
     if args.create:
         client.ensure_index(args.host, args.index, {"keys": args.index_keys})
         field_opts = {
@@ -139,9 +152,7 @@ def _flush_import(client, args, batch) -> None:
 
 
 def cmd_export(args) -> int:
-    from .server.client import InternalClient
-
-    client = InternalClient()
+    client = _ctl_client(args)
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     try:
         shards = client.shards_max(args.host).get(args.index, 0)
@@ -229,6 +240,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("import", help="bulk-import CSV data")
     p.add_argument("--host", default="localhost:10101")
+    p.add_argument("--gossip-key", dest="gossip_key",
+                   help="path to cluster shared-secret file")
     p.add_argument("-i", "--index", required=True)
     p.add_argument("-f", "--field", required=True)
     p.add_argument("--create", action="store_true", help="create index/field first")
@@ -246,6 +259,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("export", help="export a field as CSV")
     p.add_argument("--host", default="localhost:10101")
+    p.add_argument("--gossip-key", dest="gossip_key",
+                   help="path to cluster shared-secret file")
     p.add_argument("-i", "--index", required=True)
     p.add_argument("-f", "--field", required=True)
     p.add_argument("-o", "--output", default="-")

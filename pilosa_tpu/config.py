@@ -30,6 +30,24 @@ class AntiEntropyConfig:
 
 
 @dataclass
+class GossipConfig:
+    """Membership-plane knobs (reference server/config.go:121-131 gossip{}).
+
+    The reference's memberlist UDP gossip is redesigned as HTTP heartbeat
+    probes + push/pull NodeStatus merge (server/server.py _monitor_members),
+    so the surface maps as: probe-interval/probe-timeout -> the heartbeat
+    loop's cadence and per-probe deadline; key -> a shared-secret file whose
+    contents authenticate inbound /internal/* (the moral equivalent of
+    memberlist's transport encryption key: a node without it cannot join
+    or deliver cluster messages; /status and other public API routes stay
+    open, as in the reference's HTTP plane)."""
+
+    probe_interval: float = 2.0  # seconds between member heartbeat rounds
+    probe_timeout: float = 2.0  # per-probe HTTP deadline (seconds)
+    key: str = ""  # path to shared-secret file; empty = open cluster
+
+
+@dataclass
 class MetricConfig:
     service: str = "inmem"  # inmem | nop
     host: str = ""
@@ -68,6 +86,7 @@ class Config:
     query_coalesce_window: float = 0.0
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
+    gossip: GossipConfig = field(default_factory=GossipConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     translation: TranslationConfig = field(default_factory=TranslationConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
@@ -104,6 +123,10 @@ class Config:
         self.cluster.long_query_time = c.get("long-query-time", self.cluster.long_query_time)
         a = d.get("anti-entropy", {})
         self.anti_entropy.interval = a.get("interval", self.anti_entropy.interval)
+        g = d.get("gossip", {})
+        self.gossip.probe_interval = g.get("probe-interval", self.gossip.probe_interval)
+        self.gossip.probe_timeout = g.get("probe-timeout", self.gossip.probe_timeout)
+        self.gossip.key = g.get("key", self.gossip.key)
         m = d.get("metric", {})
         self.metric.service = m.get("service", self.metric.service)
         self.metric.host = m.get("host", self.metric.host)
@@ -152,6 +175,14 @@ class Config:
         v = env("ANTI_ENTROPY_INTERVAL", float)
         if v is not None:
             self.anti_entropy.interval = v
+        for attr, name, cast in [
+            ("probe_interval", "GOSSIP_PROBE_INTERVAL", float),
+            ("probe_timeout", "GOSSIP_PROBE_TIMEOUT", float),
+            ("key", "GOSSIP_KEY", str),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.gossip, attr, v)
         v = env("TRANSLATION_PRIMARY_URL", str)
         if v is not None:
             self.translation.primary_url = v
@@ -180,6 +211,9 @@ class Config:
             "long_query_time": ("cluster", "long_query_time"),
             "query_coalesce_window": ("query_coalesce_window",),
             "anti_entropy_interval": ("anti_entropy", "interval"),
+            "gossip_probe_interval": ("gossip", "probe_interval"),
+            "gossip_probe_timeout": ("gossip", "probe_timeout"),
+            "gossip_key": ("gossip", "key"),
             "translation_primary_url": ("translation", "primary_url"),
             "tls_certificate": ("tls", "certificate_path"),
             "tls_certificate_key": ("tls", "certificate_key_path"),
@@ -223,6 +257,11 @@ class Config:
             "",
             "[anti-entropy]",
             f"interval = {self.anti_entropy.interval}",
+            "",
+            "[gossip]",
+            f"probe-interval = {self.gossip.probe_interval}",
+            f"probe-timeout = {self.gossip.probe_timeout}",
+            f"key = {fmt(self.gossip.key)}",
             "",
             "[metric]",
             f"service = {fmt(self.metric.service)}",
@@ -272,6 +311,9 @@ class Config:
             primary_translate_store_url=self.translation.primary_url or None,
             max_writes_per_request=self.max_writes_per_request,
             query_coalesce_window=self.query_coalesce_window,
+            member_monitor_interval=self.gossip.probe_interval,
+            member_probe_timeout=self.gossip.probe_timeout,
+            internal_key_path=self.gossip.key or None,
         )
         kw.update(overrides)
         return Server(**kw)

@@ -322,11 +322,15 @@ class Fragment:
 
     # ----------------------------------------------------------------- TopN
 
-    def top(self, opt: TopOptions, inter_counts: Optional[Dict[int, int]] = None) -> List[Pair]:
+    def top(self, opt: TopOptions, inter_counts: Optional[Dict[int, int]] = None,
+            src_count: Optional[int] = None) -> List[Pair]:
         """TopN over this fragment. `inter_counts` (row -> |row ∩ src| for
         THIS shard) lets the executor batch the device popcounts for many
         shards into one program and replay the heap here without any
-        per-fragment device work (heap semantics: fragment.go:899-990)."""
+        per-fragment device work (heap semantics: fragment.go:899-990).
+        `src_count` (|src| for THIS shard) comes from the same batched
+        program so tanimoto TopN (fragment.go:1008-1027) rides the batched
+        path too — without it tanimoto needs opt.src materialized."""
         pairs = self._top_pairs(list(opt.row_ids))
         n = 0 if opt.row_ids else opt.n
         has_src = opt.src is not None or inter_counts is not None
@@ -335,12 +339,14 @@ class Fragment:
 
         tanimoto = 0
         min_tan = max_tan = 0.0
-        src_count = 0
         if opt.tanimoto_threshold > 0 and opt.src is not None:
-            tanimoto = opt.tanimoto_threshold
             src_count = opt.src.count()
+        if opt.tanimoto_threshold > 0 and src_count is not None:
+            tanimoto = opt.tanimoto_threshold
             min_tan = src_count * tanimoto / 100.0
             max_tan = src_count * 100.0 / tanimoto
+        if src_count is None:
+            src_count = 0
 
         # Pre-filter candidates (cheap host checks), then batch-count the
         # survivors' intersections with src on device.
@@ -398,8 +404,19 @@ class Fragment:
             row_id, cnt = p.id, p.count
             if cnt <= 0:
                 continue
-            if opt.tanimoto_threshold > 0 and opt.src is not None:
-                if cnt <= min_tan or cnt >= max_tan:
+            if opt.tanimoto_threshold > 0:
+                # Candidate filtering branches on tanimoto BEFORE
+                # min_threshold (reference fragment.go:909-920), so
+                # min_threshold is not applied here in tanimoto mode —
+                # though the heap-full early-exit in top() still consults
+                # it, exactly as fragment.go:976-981 does. Bounds pruning:
+                # cnt outside [min_tan, max_tan] cannot reach the
+                # coefficient threshold. The bounds need src_count, so
+                # top_candidates (bounds 0/0, src not yet counted) prunes
+                # nothing here and top() re-filters with real bounds.
+                if (min_tan > 0 or max_tan > 0) and (
+                    cnt <= min_tan or cnt >= max_tan
+                ):
                     continue
             elif cnt < opt.min_threshold:
                 continue

@@ -659,6 +659,8 @@ class Executor:
         local_runner = None
         ids = self._uint_slice_arg(c, "ids")
         tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        if tanimoto > 100:
+            raise QueryError("Tanimoto Threshold is from 1 to 100 only")
         src_call = c.children[0] if c.children else None
 
         if (
@@ -696,28 +698,39 @@ class Executor:
         if (
             ids
             and not c.args.get("attrName")
-            and not tanimoto
             and src_call is not None  # without src the host rank cache has
             and self.engine.supports(src_call)  # exact counts; device adds RTT
         ):
             # Batched phase-2: all candidate counts across all local shards
-            # in one device program, preserving per-shard MinThreshold
-            # semantics (fragment.go:899-990).
+            # in one device program, preserving per-shard MinThreshold and
+            # tanimoto semantics (fragment.go:899-990, 1008-1027 — the
+            # coefficient is a pure function of the (row, inter, src)
+            # counts the program already produces).
             field_name = c.args.get("_field") or DEFAULT_FIELD
             thr = max(c.uint_arg("threshold")[0], DEFAULT_MIN_THRESHOLD)
 
             def local_runner(local_shards):
-                row_counts, inter = self.engine.topn_shard_counts(
+                import math
+
+                row_counts, inter, src_counts = self.engine.topn_shard_counts(
                     index, field_name, ids, local_shards, src_call
                 )
                 pairs: Dict[int, int] = {}
                 for ri, row_id in enumerate(ids):
                     for si in range(len(local_shards)):
                         cnt = int(row_counts[ri, si])
-                        if cnt <= 0 or cnt < thr:
+                        if cnt <= 0:
                             continue
                         count = int(inter[ri, si]) if inter is not None else cnt
-                        if count == 0 or count < thr:
+                        if count == 0:
+                            continue
+                        if tanimoto:
+                            tan = math.ceil(
+                                count * 100.0 / (cnt + int(src_counts[si]) - count)
+                            )
+                            if tan <= tanimoto:
+                                continue
+                        elif cnt < thr or count < thr:
                             continue
                         pairs[row_id] = pairs.get(row_id, 0) + count
                 return [Pair(id=r, count=n) for r, n in pairs.items()]
@@ -725,8 +738,6 @@ class Executor:
         elif (
             src_call is not None
             and not ids
-            and not c.args.get("attrName")
-            and not tanimoto
             and self.engine.supports(src_call)
         ):
             # Batched phase-1: each shard's candidate list comes from its
@@ -735,11 +746,21 @@ class Executor:
             # program — the per-fragment fallback pays a device round trip
             # per plane chunk per shard (seconds through a remote runtime).
             # Heap semantics stay exact: Fragment.top replays them from the
-            # precomputed per-shard counts (fragment.go:899-990).
+            # precomputed per-shard counts (fragment.go:899-990). Tanimoto
+            # (the ChEMBL workload, docs/examples.md:321-328) and attr
+            # filters ride this path too: the coefficient needs only the
+            # per-shard src popcount the same program produces, and attr
+            # filtering is a host-side candidate check.
             field_name = c.args.get("_field") or DEFAULT_FIELD
             n_arg, _ = c.uint_arg("n")
             thr = max(c.uint_arg("threshold")[0], DEFAULT_MIN_THRESHOLD)
-            topn_opt = TopOptions(n=n_arg, min_threshold=thr)
+            topn_opt = TopOptions(
+                n=n_arg,
+                min_threshold=thr,
+                filter_name=c.args.get("attrName", ""),
+                filter_values=c.args.get("attrValues") or [],
+                tanimoto_threshold=tanimoto,
+            )
 
             def local_runner(local_shards):
                 frags = []
@@ -761,12 +782,15 @@ class Executor:
                 inter_by_shard: Dict[int, Dict[int, int]] = {
                     s: {} for s in shard_list
                 }
+                src_count_by_shard: Dict[int, int] = {}
                 CHUNK = 512  # bounds the (R, S, W) gather working set
                 for i in range(0, len(union), CHUNK):
                     chunk = union[i : i + CHUNK]
-                    _, inter = self.engine.topn_shard_counts(
+                    _, inter, src_counts = self.engine.topn_shard_counts(
                         index, field_name, chunk, shard_list, src_call
                     )
+                    for si, s in enumerate(shard_list):
+                        src_count_by_shard[s] = int(src_counts[si])
                     for ri, r in enumerate(chunk):
                         for si, s in enumerate(shard_list):
                             inter_by_shard[s][r] = int(inter[ri, si])
@@ -775,7 +799,10 @@ class Executor:
                     counts = {
                         r: inter_by_shard[frag.shard].get(r, 0) for r, _ in cands
                     }
-                    out.extend(frag.top(topn_opt, inter_counts=counts))
+                    out.extend(frag.top(
+                        topn_opt, inter_counts=counts,
+                        src_count=src_count_by_shard[frag.shard],
+                    ))
                 return add_pairs([], out)
 
         if local_runner is not None:

@@ -677,10 +677,13 @@ class ShardedQueryEngine:
     ):
         """Per-(row, shard) count matrices in one device program.
 
-        Returns (row_counts, inter_counts): both (R, S) int arrays;
-        inter_counts is None without a src call. Per-shard granularity
-        preserves the reference's per-shard MinThreshold semantics
-        (fragment.go:899-990) while batching all popcounts.
+        Returns (row_counts, inter_counts, src_counts): the first two are
+        (R, S) int arrays, src_counts is (S,) — popcount of the src bitmap
+        per shard, which the tanimoto coefficient needs
+        (fragment.go:1008-1027). inter_counts/src_counts are None without a
+        src call. Per-shard granularity preserves the reference's per-shard
+        MinThreshold semantics (fragment.go:899-990) while batching all
+        popcounts.
         """
         shards = tuple(shards)
         leaves = [Leaf(field, VIEW_STANDARD, r) for r in row_ids]
@@ -698,17 +701,24 @@ class ShardedQueryEngine:
                         jax.lax.population_count(stacked).astype(jnp.int32), axis=2
                     )
                     src = expr(src_lv)
+                    src_counts = jnp.sum(
+                        jax.lax.population_count(src).astype(jnp.int32), axis=1
+                    )
                     masked = jnp.bitwise_and(stacked, src[None, :, :])
                     inter = jnp.sum(
                         jax.lax.population_count(masked).astype(jnp.int32), axis=2
                     )
-                    return row_counts, inter
+                    return row_counts, inter, src_counts
 
                 return fn
 
             fn = self._fn_build(self._count_fns, sig, build)
-            row_counts, inter = fn(rows_tensor, src_leaves)
-            return np.asarray(row_counts)[:, :s_real], np.asarray(inter)[:, :s_real]
+            row_counts, inter, src_counts = fn(rows_tensor, src_leaves)
+            return (
+                np.asarray(row_counts)[:, :s_real],
+                np.asarray(inter)[:, :s_real],
+                np.asarray(src_counts)[:s_real],
+            )
 
         sig = ("topn_shard", len(shards), len(row_ids))
 
@@ -722,7 +732,7 @@ class ShardedQueryEngine:
             return fn
 
         fn = self._fn_build(self._count_fns, sig, build)
-        return np.asarray(fn(rows_tensor))[:, :s_real], None
+        return np.asarray(fn(rows_tensor))[:, :s_real], None, None
 
     def topn_counts(
         self, index: str, field: str, row_ids: Sequence[int],

@@ -25,6 +25,31 @@ class ClientError(PilosaError):
         self.status = status
 
 
+def load_cluster_key(path: str) -> str:
+    """Read + validate a cluster shared-secret file (gossip.key analog).
+
+    One loader shared by Server and the ctl CLI so both reject the same
+    misconfigurations the same way: a missing file, an empty file (which
+    would silently produce an unauthenticated client), or non-ASCII
+    content (HTTP headers are latin-1 on the wire; an emoji key would
+    brick every authenticated request with opaque errors)."""
+    try:
+        with open(path) as f:
+            key = f.read().strip()
+    except OSError as e:
+        raise PilosaError(f"cannot read gossip key file {path!r}: {e}") from e
+    if not key:
+        raise PilosaError(f"gossip key file {path!r} is empty")
+    if not key.isascii() or any(ord(c) < 33 or ord(c) == 127 for c in key):
+        # Printable ASCII with no whitespace/control chars: anything else
+        # either breaks http.client at header-send time (interior newline
+        # -> 'Invalid header value') or invites invisible mismatches.
+        raise PilosaError(
+            f"gossip key file {path!r} must be printable ASCII on one line"
+        )
+    return key
+
+
 def _node_url(node) -> str:
     uri = node.uri if not isinstance(node, str) else node
     if not uri.startswith("http"):
@@ -33,8 +58,12 @@ def _node_url(node) -> str:
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0, skip_verify: bool = False):
+    def __init__(self, timeout: float = 30.0, skip_verify: bool = False,
+                 key: Optional[str] = None):
         self.timeout = timeout
+        # Cluster shared secret (gossip.key analog): sent on every request;
+        # peers with a key configured refuse unauthenticated /internal/*.
+        self.key = key
         # TLS peer-verification opt-out for self-signed cluster certs
         # (reference server/server.go:216-218 InsecureSkipVerify).
         self._ssl_context = None
@@ -53,6 +82,8 @@ class InternalClient:
             req.add_header("Content-Type", content_type)
         if accept:
             req.add_header("Accept", accept)
+        if self.key:
+            req.add_header("X-Pilosa-Key", self.key)
         kwargs = {"context": self._ssl_context} if url.startswith("https") else {}
         try:
             with urllib.request.urlopen(req, timeout=self.timeout, **kwargs) as resp:

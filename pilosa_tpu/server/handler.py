@@ -79,9 +79,16 @@ class Route:
 class Handler:
     """Routes HTTP requests to API methods."""
 
-    def __init__(self, api: API, logger=None, allowed_origins: Optional[List[str]] = None):
+    def __init__(self, api: API, logger=None, allowed_origins: Optional[List[str]] = None,
+                 internal_key: Optional[str] = None):
         self.api = api
         self.logger = logger
+        # Cluster shared secret (gossip.key analog): when set, /internal/*
+        # requires a matching X-Pilosa-Key header — an unkeyed or
+        # wrong-keyed node cannot join or deliver cluster messages. Public
+        # API routes (incl. /status, which heartbeat probes read) stay
+        # open, matching the reference's HTTP plane.
+        self.internal_key = internal_key
         # CORS allowed origins (reference http/handler.go:83-91 wraps the
         # router in gorilla handlers.CORS when configured; empty = no CORS,
         # preflight gets 405 per server/handler_test.go:555-567).
@@ -126,6 +133,19 @@ class Handler:
                  headers: Optional[Dict[str, str]] = None):
         """Returns (status, content_type, payload_bytes)."""
         headers = {k.lower(): v for k, v in (headers or {}).items()}
+        if self.internal_key and path.startswith("/internal/"):
+            import hmac
+
+            # compare_digest on BYTES: the shared secret must not leak
+            # through comparison timing, and the str overload raises
+            # TypeError on non-ASCII input (http.server decodes headers as
+            # latin-1, so an arbitrary-byte header must not crash the
+            # connection — it must 403).
+            presented = headers.get("x-pilosa-key", "").encode("latin-1", "replace")
+            if not hmac.compare_digest(presented, self.internal_key.encode()):
+                return 403, "application/json", json.dumps(
+                    {"error": "cluster key required"}
+                ).encode()
         for route in self.routes:
             if route.method != method:
                 continue

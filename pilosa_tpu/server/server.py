@@ -55,6 +55,8 @@ class Server:
         diagnostics_interval: float = 0.0,
         diagnostics_endpoint: str = "",
         member_monitor_interval: float = 2.0,
+        member_probe_timeout: float = 2.0,
+        internal_key_path: Optional[str] = None,
         join_addr: Optional[str] = None,
         allowed_origins: Optional[List[str]] = None,
         tls_certificate: Optional[str] = None,
@@ -106,8 +108,27 @@ class Server:
             os.path.join(data_dir, "keys") if data_dir else None,
             read_only=primary_translate_store_url is not None,
         )
-        self.client = InternalClient(skip_verify=tls_skip_verify)
-        self._probe_client = InternalClient(timeout=2.0, skip_verify=tls_skip_verify)
+        # Cluster shared secret (reference gossip.Key, server/config.go:126:
+        # memberlist transport encryption). Redesigned for the HTTP
+        # membership plane: the file's contents ride every internal request
+        # as X-Pilosa-Key and peers refuse inbound /internal/* without a
+        # match — an unkeyed node can't join or deliver cluster messages.
+        # Scope: /internal/* ONLY. /status (which heartbeat probes read)
+        # and /cluster/resize/* stay public, matching the reference's HTTP
+        # API posture (its memberlist key encrypts only UDP gossip; its
+        # HTTP plane has no auth at all).
+        self.internal_key: Optional[str] = None
+        if internal_key_path:
+            from .client import load_cluster_key
+
+            self.internal_key = load_cluster_key(internal_key_path)
+        self.client = InternalClient(
+            skip_verify=tls_skip_verify, key=self.internal_key
+        )
+        self._probe_client = InternalClient(
+            timeout=member_probe_timeout, skip_verify=tls_skip_verify,
+            key=self.internal_key,
+        )
         self.executor = Executor(
             self.holder,
             cluster=self.cluster,
@@ -118,7 +139,10 @@ class Server:
             coalesce_window=query_coalesce_window,
         )
         self.api = API(self)
-        self.handler = Handler(self.api, logger=self.logger, allowed_origins=allowed_origins)
+        self.handler = Handler(
+            self.api, logger=self.logger, allowed_origins=allowed_origins,
+            internal_key=self.internal_key,
+        )
 
         from ..cluster.topology import Topology
         from ..diagnostics import DiagnosticsCollector

@@ -29,6 +29,125 @@ def test_config_precedence(tmp_path, monkeypatch):
     assert cfg.data_dir == "/from/flag"
 
 
+def test_gossip_config_surface(tmp_path, monkeypatch):
+    """Reference server/config.go:121-131 gossip{} knobs: TOML + env + flag
+    precedence, and build_server wiring into the heartbeat monitor."""
+    cfg_file = tmp_path / "cfg.toml"
+    cfg_file.write_text(
+        "[gossip]\nprobe-interval = 7.5\nprobe-timeout = 1.5\n"
+        'key = "/from/file.key"\n'
+    )
+    cfg = Config.load(str(cfg_file))
+    assert cfg.gossip.probe_interval == 7.5
+    assert cfg.gossip.probe_timeout == 1.5
+    assert cfg.gossip.key == "/from/file.key"
+    monkeypatch.setenv("PILOSA_TPU_GOSSIP_PROBE_INTERVAL", "3.0")
+    cfg = Config.load(str(cfg_file))
+    assert cfg.gossip.probe_interval == 3.0
+    cfg = Config.load(str(cfg_file), {"gossip_probe_interval": 9.0})
+    assert cfg.gossip.probe_interval == 9.0
+    # Round-trips through generate-config output.
+    p = tmp_path / "rt.toml"
+    p.write_text(cfg.to_toml())
+    rt = Config.load(str(p))
+    # (env still set, so compare the file-only fields)
+    assert rt.gossip.probe_timeout == 1.5
+    assert rt.gossip.key == "/from/file.key"
+
+    keyfile = tmp_path / "secret.key"
+    keyfile.write_text("s3cret\n")
+    cfg = Config()
+    cfg.data_dir = str(tmp_path / "d")
+    cfg.bind = "localhost:0"
+    cfg.gossip.probe_interval = 0  # don't spawn the monitor in tests
+    cfg.gossip.probe_timeout = 0.5
+    cfg.gossip.key = str(keyfile)
+    s = cfg.build_server(executor_workers=0, cache_flush_interval=0)
+    try:
+        assert s.internal_key == "s3cret"
+        assert s._probe_client.timeout == 0.5
+        assert s._probe_client.key == "s3cret"
+        assert s.member_monitor_interval == 0
+    finally:
+        pass  # never opened
+
+
+def test_internal_key_enforced(tmp_path):
+    """A node with a cluster key refuses unauthenticated /internal/* (the
+    memberlist-encryption analog): wrong key -> 403, right key -> 200,
+    public routes stay open."""
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.server.client import ClientError, InternalClient
+
+    keyfile = tmp_path / "k"
+    keyfile.write_text("hunter2")
+    s = Server(
+        data_dir=str(tmp_path / "node"),
+        port=0,
+        cache_flush_interval=0,
+        member_monitor_interval=0,
+        executor_workers=0,
+        internal_key_path=str(keyfile),
+    )
+    s.open()
+    try:
+        h = f"localhost:{s.port}"
+        # Unkeyed client: public route OK, internal route 403.
+        anon = InternalClient()
+        assert anon.status(h)["state"] is not None
+        with pytest.raises(ClientError) as ei:
+            anon.shards_max(h)
+        assert ei.value.status == 403
+        # Wrong key: still 403.
+        wrong = InternalClient(key="nope")
+        with pytest.raises(ClientError) as ei:
+            wrong.shards_max(h)
+        assert ei.value.status == 403
+        # Right key: internal plane open.
+        good = InternalClient(key="hunter2")
+        assert good.shards_max(h) is not None
+        # Non-ASCII header bytes must 403, not crash the connection
+        # (http.server hands headers to the gate as latin-1 str).
+        req = urllib.request.Request(
+            f"http://{h}/internal/shards/max",
+            headers={"X-Pilosa-Key": "k\xe9y"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as he:
+            urllib.request.urlopen(req, timeout=5)
+        assert he.value.code == 403
+    finally:
+        s.close()
+
+
+def test_cluster_key_file_validation(tmp_path):
+    """One shared loader rejects the same misconfigurations for Server and
+    the ctl CLI: missing, empty, and non-ASCII key files."""
+    from pilosa_tpu.errors import PilosaError
+    from pilosa_tpu.server.client import load_cluster_key
+
+    with pytest.raises(PilosaError, match="cannot read"):
+        load_cluster_key(str(tmp_path / "nope"))
+    empty = tmp_path / "empty"
+    empty.write_text("  \n")
+    with pytest.raises(PilosaError, match="empty"):
+        load_cluster_key(str(empty))
+    emoji = tmp_path / "emoji"
+    emoji.write_text("kéy")
+    with pytest.raises(PilosaError, match="ASCII"):
+        load_cluster_key(str(emoji))
+    # Interior newline would blow up http.client at header-send time —
+    # must be rejected at load, not on the first probe.
+    twolines = tmp_path / "twolines"
+    twolines.write_text("line1\nline2\n")
+    with pytest.raises(PilosaError, match="one line"):
+        load_cluster_key(str(twolines))
+    ok = tmp_path / "ok"
+    ok.write_text("hunter2\n")
+    assert load_cluster_key(str(ok)) == "hunter2"
+
+
 def test_config_toml_roundtrip(tmp_path):
     toml = Config().to_toml()
     p = tmp_path / "default.toml"

@@ -152,6 +152,95 @@ def test_topn_with_src_batched_matches_fallback(holder, ex):
     assert got == want and got, (got, want)
 
 
+def _force_fallback_topn(ex, q, src_field="g"):
+    """Run `q` with the engine refusing the src Row, forcing the
+    per-fragment TopN fallback (the semantic oracle for the batched path)."""
+    real_supports = ex.engine.supports
+
+    def no_src_supports(call):
+        if call.name == "Row" and call.args.get(src_field) is not None:
+            return False
+        return real_supports(call)
+
+    ex.engine.supports = no_src_supports
+    try:
+        return [(p.id, p.count) for p in ex.execute("i", q)[0]]
+    finally:
+        ex.engine.supports = real_supports
+
+
+def test_topn_tanimoto_batched_matches_fallback(holder, ex):
+    """Tanimoto TopN (the ChEMBL workload, docs/examples.md:321-328) rides
+    the batched device path: the coefficient is a pure function of
+    (cache_count, inter_count, src_count), all produced by ONE
+    topn_shard_counts program — results must equal the per-fragment
+    fallback (fragment.go:1008-1027 semantics)."""
+    import numpy as np
+
+    setup_index(holder)
+    rng = np.random.default_rng(23)
+    fld = holder.index("i").field("f")
+    g = holder.index("i").field("g")
+    n_rows, n_shards = 20, 3
+    rows, cols = [], []
+    for row in range(n_rows):
+        for s in range(n_shards):
+            c = rng.choice(2048, size=32 + 8 * row, replace=False)
+            rows.extend([row] * len(c))
+            cols.extend(int(s * SHARD_WIDTH + x) for x in c)
+    fld.import_bits(rows, cols)
+    gc = [int(s * SHARD_WIDTH + x)
+          for s in range(n_shards) for x in rng.choice(2048, 300, replace=False)]
+    g.import_bits([3] * len(gc), gc)
+
+    for extra in ("", ", threshold=60"):
+        # An explicit threshold must not prune tanimoto candidates
+        # (reference fragment.go:909-920 branches on tanimoto before
+        # minThreshold; only the heap-full early-exit, fragment.go:976-981,
+        # consults it). Batched and fallback paths must agree either way.
+        for thr in (5, 25, 60):
+            q = f"TopN(f, Row(g=3), n=10, tanimotoThreshold={thr}{extra})"
+            got = [(p.id, p.count) for p in ex.execute("i", q)[0]]
+            want = _force_fallback_topn(ex, q)
+            assert got == want, (thr, extra, got, want)
+    # At least one threshold must produce hits or the parity is vacuous.
+    assert _force_fallback_topn(ex, "TopN(f, Row(g=3), n=10, tanimotoThreshold=5)")
+
+
+def test_topn_attr_filter_with_src_batched_matches_fallback(holder, ex):
+    """Attr-filtered TopN WITH a src bitmap goes through the batched
+    phase-1 path (attr filtering is a host-side candidate check; only
+    surviving candidates ride the device program)."""
+    import numpy as np
+
+    setup_index(holder)
+    rng = np.random.default_rng(31)
+    fld = holder.index("i").field("f")
+    g = holder.index("i").field("g")
+    for row in range(12):
+        c = rng.choice(2048, size=64, replace=False)
+        fld.import_bits([row] * len(c), [int(x) for x in c])
+        ex.execute("i", f'SetRowAttrs(f, {row}, category="{"even" if row % 2 == 0 else "odd"}")')
+    gc = [int(x) for x in rng.choice(2048, 500, replace=False)]
+    g.import_bits([3] * len(gc), gc)
+
+    q = 'TopN(f, Row(g=3), n=6, attrName="category", attrValues=["even"])'
+    got = [(p.id, p.count) for p in ex.execute("i", q)[0]]
+    want = _force_fallback_topn(ex, q)
+    assert got == want and got, (got, want)
+    assert all(r % 2 == 0 for r, _ in got)
+
+
+def test_topn_tanimoto_over_100_rejected(holder, ex):
+    setup_index(holder)
+    ex.execute("i", "Set(1, f=10)")
+    ex.execute("i", "Set(1, g=3)")
+    from pilosa_tpu.errors import QueryError
+
+    with pytest.raises(QueryError):
+        ex.execute("i", "TopN(f, Row(g=3), n=5, tanimotoThreshold=101)")
+
+
 def test_sum_min_max(holder, ex):
     idx = setup_index(holder)
     idx.create_field_if_not_exists("v", FieldOptions(type="int", min=-10, max=1000))
