@@ -18,12 +18,14 @@ a dead tunnel is distinguishable from broken code, and BENCH_REQUIRE_TPU=1
 exits non-zero instead of silently benchmarking the CPU.
 
 Env knobs: BENCH_SHARDS (default 8), BENCH_ROWS (default 128),
-BENCH_DENSITY (default 0.02), BENCH_ITERS (default 128, capped at
-BENCH_ROWS so batches contain no duplicate queries), BENCH_PROBE_TIMEOUT
-(per-attempt seconds, default 150), BENCH_PROBE_ATTEMPTS (default 3),
-BENCH_REQUIRE_TPU=1 (fail instead of CPU fallback), BENCH_FORCE_PLATFORM,
-BENCH_PALLAS=0 (skip kernel stanza), BENCH_SCALE=0 (skip HBM-pressure
-stanza).
+BENCH_DENSITY (default 0.02), BENCH_ITERS (default 1024, capped at
+BENCH_ROWS*(BENCH_ROWS-1) so batches contain no duplicate queries),
+BENCH_PROBE_TIMEOUT (per-attempt seconds, default 150),
+BENCH_PROBE_ATTEMPTS (default 3), BENCH_REQUIRE_TPU=1 (fail instead of
+CPU fallback), BENCH_FORCE_PLATFORM, BENCH_HBM_GIB (resident-stack size
+for the bandwidth stanza; default 8 on TPU / 0.125 on CPU), and
+BENCH_{HBM,SCALE,OPEN,SERVING,TOPN_BSI,TIME_RANGE}=0 to skip a stanza
+(the Pallas-vs-XLA kernel race lives inside the HBM stanza).
 """
 
 import json
@@ -187,14 +189,31 @@ def build(n_shards, n_rows, density):
     return holder, Executor(holder, workers=0)
 
 
+def _distinct_pairs(n_rows, iters):
+    """`iters` DISTINCT (a, b) row pairs: offset-k ring pairs (i, i+k).
+
+    Distinctness matters for honesty: the engine's within-batch
+    memoization collapses duplicate queries (at full counted weight), so a
+    batch of repeats would measure dict lookups, not device work. With
+    n*(n-1) distinct ordered pairs available, batch sizes far beyond
+    n_rows stay duplicate-free."""
+    pairs = []
+    for off in range(1, n_rows):
+        for i in range(n_rows):
+            pairs.append((i, (i + off) % n_rows))
+            if len(pairs) == iters:
+                return pairs
+    return pairs
+
+
 def bench_device(ex, n_rows, n_shards, iters):
     from pilosa_tpu.pql.parser import parse
 
     engine = ex.engine
     shards = list(range(n_shards))
     calls = [
-        parse(f"Count(Intersect(Row(f={i % n_rows}), Row(f={(i + 1) % n_rows})))").calls[0].children[0]
-        for i in range(iters)
+        parse(f"Count(Intersect(Row(f={a}), Row(f={b})))").calls[0].children[0]
+        for a, b in _distinct_pairs(n_rows, iters)
     ]
     # Warmup: compile the batch program + populate the device leaf cache.
     engine.count_batch("bench", calls, shards)
@@ -220,7 +239,7 @@ def bench_device(ex, n_rows, n_shards, iters):
     count_qps = done / (time.perf_counter() - start)
 
     start = time.perf_counter()
-    topn_iters = max(3, iters // 4)
+    topn_iters = max(3, min(iters // 4, 32))
     for _ in range(topn_iters):
         ex.execute("bench", "TopN(f, n=5)")
     topn_qps = topn_iters / (time.perf_counter() - start)
@@ -284,96 +303,165 @@ def bench_host(holder, n_rows, n_shards, iters):
                            **{k: round(v, 2) for k, v in results.items()}}
 
 
-# ------------------------------------------------- Pallas kernel validation
+# ---------------------------------------- HBM-bandwidth / kernel stanza
 
 
-def bench_pallas():
-    """Run the Pallas kernels COMPILED (not interpret) on the live device
-    and compare against the plain-XLA formulations of the same ops.
+# Chip peak HBM bandwidth (GB/s) by device_kind, for pct-of-peak
+# reporting (public spec sheets; v5 lite == v5e).
+_PEAK_GBS = {
+    "TPU v2": 700, "TPU v3": 900, "TPU v4": 1228, "TPU v4 lite": 614,
+    "TPU v5 lite": 819, "TPU v5e": 819, "TPU v5": 2765, "TPU v5p": 2765,
+    "TPU v6 lite": 1640, "TPU v6e": 1640,
+}
 
-    Returns a detail dict with words/sec per kernel — or the error that
-    proves where compilation fails on this hardware (the gather kernel's
-    scalar-prefetch DMA indexing can only be validated on a real chip)."""
-    out = {}
-    if not _on_tpu_platform():
-        out["skipped"] = "not on a TPU backend (interpret mode would not validate the kernels)"
-        return out
+
+def _measure_rtt():
+    """Round-trip of a trivial dispatch+fetch — the per-call tax every
+    blocking device result pays on this link (~70ms through the axon
+    tunnel, ~0 on a local backend). Subtracted from in-program-loop
+    timings so the kernel numbers measure the device, not the tunnel."""
     import jax
     import jax.numpy as jnp
 
+    tiny = jax.jit(lambda x: x + 1)
+    v = int(tiny(jnp.int32(1)))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        v = int(tiny(jnp.int32(v)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_hbm():
+    """Batched-count throughput on an HBM-resident leaf stack at real scale
+    (BASELINE.md north-star shape scaled to one chip's memory).
+
+    Builds a device-resident (U, S, W) uint32 stack (default 8 GiB on TPU
+    — PRNG-generated on device; pushing 8 GiB of real fragments through
+    the host import path would measure the tunnel, and the serving stanzas
+    already exercise the full engine on real fragments), then runs the
+    EXACT batched-count program shapes the engine compiles
+    (parallel/engine.py:_count_batch_setops): Q gathered 2-leaf
+    Intersect counts per iteration, R iterations inside one compiled
+    program (lax.fori_loop) so the per-dispatch RTT amortizes.
+
+    Reports achieved GB/s (gather traffic / time, RTT-subtracted) and the
+    fraction of the chip's peak HBM bandwidth for:
+      - stream: popcount over the whole stack (the no-gather ceiling)
+      - xla_gather: the engine's XLA fallback formulation
+      - pallas_gather: ops/pallas_kernels.batched_gather_expr_count
+    plus per-path effective queries/sec and the Pallas-vs-XLA ratio.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pilosa_tpu.constants import WORDS_PER_ROW
     from pilosa_tpu.ops import pallas_kernels as pk
 
-    rng = np.random.default_rng(7)
+    on_tpu = _on_tpu_platform()
+    default_gib = "8" if on_tpu else "0.125"
+    gib = float(os.environ.get("BENCH_HBM_GIB", default_gib))
+    s, w = 8, WORDS_PER_ROW
+    u = max(16, int(gib * 2**30 / (s * w * 4)))
+    q = min(1024, u)
+    r = 16
+    out = {"stack_gib": round(u * s * w * 4 / 2**30, 3),
+           "shape": [u, s, w], "batch_q": q, "loop_r": r}
 
-    def timeit(fn, *args, reps=20):
-        fn(*args).block_until_ready()  # compile + warm
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            r = fn(*args)
-        r.block_until_ready()
-        return (time.perf_counter() - t0) / reps
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    t0 = time.perf_counter()
+    stacked = jax.random.bits(k1, (u, s, w), dtype=jnp.uint32)
+    stacked.block_until_ready()
+    out["build_s"] = round(time.perf_counter() - t0, 1)
+    ia = jax.random.randint(k2, (r, q), 0, u, dtype=jnp.int32)
+    ib = jax.random.randint(k3, (r, q), 0, u, dtype=jnp.int32)
+    rtt = _measure_rtt()
+    out["rtt_ms"] = round(rtt * 1e3, 1)
+    peak = _PEAK_GBS.get(_device_info()["device_kind"])
+    expr = lambda planes: jnp.bitwise_and(planes[0], planes[1])
 
-    # --- fused_nary_count: Intersect of 2 planes, 8 MiB per plane.
-    n_words = 1 << 21
-    try:
-        a = jnp.asarray(rng.integers(0, 1 << 32, n_words, dtype=np.uint32))
-        b = jnp.asarray(rng.integers(0, 1 << 32, n_words, dtype=np.uint32))
-        tape = ((pk.OP_AND, 0, 1),)
-        xla_fn = jax.jit(
-            lambda x, y: jnp.sum(jax.lax.population_count(jnp.bitwise_and(x, y)).astype(jnp.int32))
-        )
-        want = int(xla_fn(a, b))
-        got = int(pk.fused_nary_count(tape, a, b))
-        assert got == want, (got, want)
-        t_pallas = timeit(lambda x, y: pk.fused_nary_count(tape, x, y), a, b)
-        t_xla = timeit(xla_fn, a, b)
-        out["fused_nary_count"] = {
-            "gwords_per_s": round(n_words / t_pallas / 1e9, 2),
-            "xla_gwords_per_s": round(n_words / t_xla / 1e9, 2),
-            "vs_xla": round(t_xla / t_pallas, 3),
-            "verified": True,
-        }
-    except Exception as e:
-        out["fused_nary_count"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+    def record(label, fn, nbytes):
+        try:
+            t0 = time.perf_counter()
+            got = int(fn())
+            compile_s = time.perf_counter() - t0
+            best = 1e9
+            for _ in range(3):
+                t0 = time.perf_counter()
+                int(fn())
+                best = min(best, time.perf_counter() - t0)
+            dt = max(best - rtt, 1e-9)
+            gbs = nbytes / dt / 1e9
+            entry = {"ms": round(best * 1e3, 1), "gbs": round(gbs, 1),
+                     "compile_s": round(compile_s, 1)}
+            if peak:
+                entry["pct_of_peak"] = round(gbs / peak * 100, 1)
+            if label != "stream":
+                entry["qps"] = round(r * q / dt, 0)
+            out[label] = entry
+            return got
+        except Exception as e:
+            out[label] = {"error": f"{type(e).__name__}: {e}"[:400]}
+            return None
 
-    # --- batched_gather_expr_count: Q=64 2-leaf queries over a (64, 8, W)
-    # resident stack (the scalar-prefetch DMA path).
-    try:
-        from pilosa_tpu.constants import WORDS_PER_ROW
+    # --- ceiling: stream the whole stack R times (popcount+reduce). The
+    # body depends on the carry so XLA cannot hoist it out of the loop.
+    @jax.jit
+    def stream(stacked):
+        flat = stacked.reshape(-1)
 
-        U, S, Q = 64, 8, 64
-        stacked = jnp.asarray(
-            rng.integers(0, 1 << 32, (U, S, WORDS_PER_ROW), dtype=np.uint32)
-        )
-        idx_a = jnp.asarray(rng.integers(0, U, Q, dtype=np.int32))
-        idx_b = jnp.asarray(rng.integers(0, U, Q, dtype=np.int32))
-        expr = lambda planes: jnp.bitwise_and(planes[0], planes[1])
+        def body(i, acc):
+            x = flat + acc.astype(jnp.uint32)
+            return acc + jnp.sum(lax.population_count(x).astype(jnp.int32))
 
-        @jax.jit
-        def gather_kernel(stacked, ia, ib):
-            return pk.batched_gather_expr_count(stacked, (ia, ib), expr)
+        return lax.fori_loop(0, r, body, jnp.int32(0))
 
-        @jax.jit
-        def gather_xla(stacked, ia, ib):
-            plane = jnp.bitwise_and(stacked[ia], stacked[ib])
-            return jnp.sum(
-                jax.lax.population_count(plane).astype(jnp.int32), axis=(1, 2)
+    record("stream", lambda: stream(stacked), r * u * s * w * 4)
+
+    gather_bytes = r * q * 2 * s * w * 4
+
+    @jax.jit
+    def xla_gather(stacked, ia, ib):
+        def body(i, acc):
+            leaves = (stacked[ia[i]], stacked[ib[i]])  # (Q, S, W) each
+            plane = expr(leaves)
+            counts = jnp.sum(
+                lax.population_count(plane).astype(jnp.int32), axis=(1, 2)
             )
+            return acc + jnp.sum(counts)
 
-        got = np.asarray(gather_kernel(stacked, idx_a, idx_b))
-        want = np.asarray(gather_xla(stacked, idx_a, idx_b))
-        assert (got == want).all(), "gather kernel mismatch vs XLA"
-        t_pallas = timeit(gather_kernel, stacked, idx_a, idx_b)
-        t_xla = timeit(gather_xla, stacked, idx_a, idx_b)
-        words = Q * S * WORDS_PER_ROW
-        out["batched_gather_expr_count"] = {
-            "gwords_per_s": round(words / t_pallas / 1e9, 2),
-            "xla_gwords_per_s": round(words / t_xla / 1e9, 2),
-            "vs_xla": round(t_xla / t_pallas, 3),
-            "verified": True,
+        return lax.fori_loop(0, r, body, jnp.int32(0))
+
+    got_xla = record("xla_gather", lambda: xla_gather(stacked, ia, ib),
+                     gather_bytes)
+
+    if on_tpu:
+        @jax.jit
+        def pallas_gather(stacked, ia, ib):
+            def body(i, acc):
+                counts = pk.batched_gather_expr_count(
+                    stacked, (ia[i], ib[i]), expr
+                )
+                return acc + jnp.sum(counts)
+
+            return lax.fori_loop(0, r, body, jnp.int32(0))
+
+        got_pl = record("pallas_gather", lambda: pallas_gather(stacked, ia, ib),
+                        gather_bytes)
+        if got_xla is not None and got_pl is not None:
+            out["verified"] = bool(got_xla == got_pl)
+            if "ms" in out.get("xla_gather", {}) and "ms" in out.get("pallas_gather", {}):
+                out["pallas_vs_xla"] = round(
+                    (out["xla_gather"]["ms"] - out["rtt_ms"])
+                    / max(out["pallas_gather"]["ms"] - out["rtt_ms"], 1e-9), 3
+                )
+    else:
+        out["pallas_gather"] = {
+            "skipped": "interpret mode would not validate the kernel"
         }
-    except Exception as e:
-        out["batched_gather_expr_count"] = {"error": f"{type(e).__name__}: {e}"[:500]}
     return out
 
 
@@ -426,14 +514,30 @@ def bench_scale():
     cold_s = time.perf_counter() - t0
     cold_counters = dict(engine.counters)
 
-    # Warm working set: fits in budget, so the second pass must be all hits.
+    # Warm working set: fits in budget. A repeat query is answered by the
+    # host result memo (O(dict lookup), no device round trip at all) —
+    # this is the hot-query serving path, so measure it as such, then
+    # bypass the memo to measure the device leaf-cache-hit path too.
     warm_rows = list(range(n_rows // 4))
     for r in warm_rows:
-        engine.count("scale", calls[r], shards)  # populate
+        engine.count("scale", calls[r], shards)  # populate memo + caches
     base = dict(engine.counters)
     t0 = time.perf_counter()
     for r in warm_rows:
         engine.count("scale", calls[r], shards)
+    memo_s = time.perf_counter() - t0
+    memo_hits = engine.counters["memo_hits"] - base["memo_hits"]
+
+    # The memo populate pass above never touched the leaf cache (memo
+    # short-circuits), so load the planes once, then measure dispatches
+    # against a warm device cache (count_async skips the memo: every
+    # query pays a real dispatch).
+    for r in warm_rows:
+        np.asarray(engine.count_async("scale", calls[r], shards))
+    base = dict(engine.counters)
+    t0 = time.perf_counter()
+    for r in warm_rows:
+        np.asarray(engine.count_async("scale", calls[r], shards))
     warm_s = time.perf_counter() - t0
     warm_hits = engine.counters["leaf_hits"] - base["leaf_hits"]
     warm_misses = engine.counters["leaf_misses"] - base["leaf_misses"]
@@ -443,6 +547,8 @@ def bench_scale():
         "budget_mib": round(budget / 2**20, 1),
         "touched_mib": round(n_rows * plane_bytes / 2**20, 1),
         "cold_ms_per_query": round(cold_s / n_rows * 1e3, 2),
+        "memo_ms_per_query": round(memo_s / len(warm_rows) * 1e3, 3),
+        "memo_hit_rate": round(memo_hits / len(warm_rows), 3),
         "warm_ms_per_query": round(warm_s / len(warm_rows) * 1e3, 2),
         "cold_evictions": cold_counters["leaf_evictions"],
         "warm_hit_rate": round(warm_hits / max(warm_hits + warm_misses, 1), 3),
@@ -520,6 +626,211 @@ def bench_serving():
     return out
 
 
+# --------------------------------------------- north-star ladder stanzas
+
+
+def _qps(fn, reps):
+    """Warm once (compile + caches), then best-effort steady-state qps."""
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return reps / (time.perf_counter() - t0)
+
+
+def bench_topn_bsi():
+    """BASELINE.md north-star config 3: TopN with ranked cache + BSI
+    Sum/Min/Max under a bitmap filter, device batched paths vs the host
+    per-fragment numpy path (frag.sum/min/max + cache-candidate top — the
+    same per-shard loop shape the reference runs per goroutine)."""
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.fragment import TopOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.pql.parser import parse
+
+    n_shards, n_rows = 8, 256
+    bits_per_row_shard = 4096
+    vals_per_shard = 65536
+    rng = np.random.default_rng(5)
+
+    holder = Holder(None)
+    holder.open()
+    idx = holder.create_index("ns3")
+    fld = idx.create_field("f")
+    vfld = idx.create_field("v", FieldOptions(type="int", min=0, max=100000))
+    rows, cols = [], []
+    for row in range(n_rows):
+        for shard in range(n_shards):
+            c = rng.choice(SHARD_WIDTH, size=bits_per_row_shard, replace=False)
+            rows.append(np.full(bits_per_row_shard, row, dtype=np.uint64))
+            cols.append(c.astype(np.uint64) + np.uint64(shard * SHARD_WIDTH))
+    fld.import_bits(np.concatenate(rows), np.concatenate(cols))
+    for shard in range(n_shards):
+        c = rng.choice(SHARD_WIDTH, size=vals_per_shard, replace=False)
+        vals = rng.integers(0, 100000, vals_per_shard)
+        vfld.import_value(
+            c.astype(np.uint64) + np.uint64(shard * SHARD_WIDTH),
+            vals.astype(np.uint64),
+        )
+    ex = Executor(holder, workers=0)
+    shards = list(range(n_shards))
+    out = {"shards": n_shards, "rows": n_rows,
+           "bsi_cols": n_shards * vals_per_shard}
+
+    # --- TopN with ranked cache + src filter (device batched phase-1+2).
+    q_topn = "TopN(f, Row(f=3), n=10)"
+    device_topn = ex.execute("ns3", q_topn)[0]
+    out["topn_qps_device"] = round(_qps(lambda: ex.execute("ns3", q_topn), 8), 2)
+
+    # Host: per-fragment candidate top with numpy popcount intersections
+    # (cache candidates -> plane AND+popcount per shard).
+    bsig = vfld.bsi_group("v")
+    depth = bsig.bit_depth()
+
+    def host_topn():
+        from pilosa_tpu.core.cache import Pair, add_pairs, sort_pairs
+
+        pairs = []
+        for s in shards:
+            frag = holder.fragment("ns3", "f", "standard", s)
+            src_plane = frag.plane_np(3)
+            cands = frag.top_candidates(TopOptions(n=10))
+            counts = {}
+            for r, _ in cands:
+                plane = frag.plane_np(r)
+                counts[r] = int(
+                    np.bitwise_count(np.bitwise_and(plane, src_plane)).sum()
+                )
+            pairs = add_pairs(pairs, frag.top(
+                TopOptions(n=10), inter_counts=counts))
+        return sort_pairs(pairs)[:10]
+
+    host_pairs = host_topn()
+    assert [(p.id, p.count) for p in host_pairs] == \
+        [(p.id, p.count) for p in device_topn[:10]], "topn host/device diverge"
+    out["topn_qps_host"] = round(_qps(host_topn, 4), 2)
+    out["topn_vs_host"] = round(out["topn_qps_device"] / out["topn_qps_host"], 2)
+
+    # --- BSI Sum/Min/Max under a Row filter (device: one batched program
+    # over all shards; host: per-fragment frag.sum/min/max numpy loop).
+    for kind, q in (("sum", "Sum(Row(f=3), field=v)"),
+                    ("min", "Min(Row(f=3), field=v)"),
+                    ("max", "Max(Row(f=3), field=v)")):
+        device_val = ex.execute("ns3", q)[0]
+        out[f"{kind}_qps_device"] = round(
+            _qps(lambda q=q: ex.execute("ns3", q), 8), 2)
+
+        filter_call = parse("Row(f=3)").calls[0]
+
+        def host_val(kind=kind):
+            total_sum = total_cnt = 0
+            best = None
+            for s in shards:
+                frag = holder.fragment("ns3", "v", "bsig_v", s)
+                if frag is None:
+                    continue
+                f_frag = holder.fragment("ns3", "f", "standard", s)
+                filter_row = f_frag.row(3)
+                if kind == "sum":
+                    vsum, vcount = frag.sum(filter_row, depth)
+                    total_sum += vsum
+                    total_cnt += vcount
+                elif kind == "min":
+                    v, cnt = frag.min(filter_row, depth)
+                    if cnt and (best is None or v < best):
+                        best = v
+                else:
+                    v, cnt = frag.max(filter_row, depth)
+                    if cnt and (best is None or v > best):
+                        best = v
+            return (total_sum, total_cnt) if kind == "sum" else best
+
+        host_result = host_val()
+        if kind == "sum":
+            assert host_result[0] + host_result[1] * bsig.min == device_val.val
+        out[f"{kind}_qps_host"] = round(_qps(host_val, 4), 2)
+        out[f"{kind}_vs_host"] = round(
+            out[f"{kind}_qps_device"] / out[f"{kind}_qps_host"], 2)
+    holder.close()
+    return out
+
+
+def bench_time_range():
+    """BASELINE.md north-star config 4: time-quantum Range (union of YMD
+    views) feeding a row-attribute-filtered TopN, vs the host per-view
+    numpy union."""
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+
+    n_shards, n_rows, n_days = 4, 32, 30
+    bits_per_day = 512
+    rng = np.random.default_rng(13)
+    holder = Holder(None)
+    holder.open()
+    idx = holder.create_index("ns4")
+    tfld = idx.create_field("t", FieldOptions(type="time", time_quantum="YMD"))
+    from pilosa_tpu.timeq import parse_timestamp
+
+    rows, cols, stamps = [], [], []
+    for row in range(n_rows):
+        for day in range(n_days):
+            ts = parse_timestamp(f"2018-01-{day % 28 + 1:02d}T00:00")
+            for shard in range(n_shards):
+                c = rng.choice(SHARD_WIDTH, size=bits_per_day, replace=False)
+                rows.append(np.full(bits_per_day, row, dtype=np.uint64))
+                cols.append(c.astype(np.uint64) + np.uint64(shard * SHARD_WIDTH))
+                stamps.extend([ts] * bits_per_day)
+    tfld.import_bits(np.concatenate(rows), np.concatenate(cols), stamps)
+    for row in range(n_rows):
+        tfld.row_attr_store.set_attrs(
+            row, {"team": "a" if row % 2 == 0 else "b"})
+    ex = Executor(holder, workers=0)
+    out = {"shards": n_shards, "rows": n_rows, "days": n_days}
+
+    q_range = "Count(Range(t=3, 2018-01-05T00:00, 2018-01-15T00:00))"
+    device_count = ex.execute("ns4", q_range)[0]
+    out["range_count_qps_device"] = round(
+        _qps(lambda: ex.execute("ns4", q_range), 8), 2)
+
+    # Host: numpy OR of the day-view planes, popcounted.
+    from pilosa_tpu.timeq import views_by_time_range
+
+    def host_range():
+        t1 = parse_timestamp("2018-01-05T00:00")
+        t2 = parse_timestamp("2018-01-15T00:00")
+        total = 0
+        for s in range(n_shards):
+            acc = None
+            for view in views_by_time_range("standard", t1, t2, "YMD"):
+                frag = holder.fragment("ns4", "t", view, s)
+                if frag is None:
+                    continue
+                plane = frag.plane_np(3)
+                acc = plane if acc is None else np.bitwise_or(acc, plane)
+            if acc is not None:
+                total += int(np.bitwise_count(acc).sum())
+        return total
+
+    assert host_range() == device_count, "range host/device diverge"
+    out["range_count_qps_host"] = round(_qps(host_range, 4), 2)
+    out["range_vs_host"] = round(
+        out["range_count_qps_device"] / out["range_count_qps_host"], 2)
+
+    # Row-attribute-filtered TopN over the standard view (the docs'
+    # segmentation pattern: TopN(t, attrName=..., attrValues=[...])).
+    q_topn = 'TopN(t, n=8, attrName="team", attrValues=["a"])'
+    pairs = ex.execute("ns4", q_topn)[0]
+    assert pairs and all(p.id % 2 == 0 for p in pairs)
+    out["attr_topn_qps_device"] = round(
+        _qps(lambda: ex.execute("ns4", q_topn), 8), 2)
+    holder.close()
+    return out
+
+
 # ------------------------------------------------------- open-time stanza
 
 
@@ -571,10 +882,11 @@ def main():
     n_shards = int(os.environ.get("BENCH_SHARDS", "8"))
     n_rows = int(os.environ.get("BENCH_ROWS", "128"))
     density = float(os.environ.get("BENCH_DENSITY", "0.02"))
-    # Cap batch size at n_rows: every query in a batch is then distinct, so
-    # the engine's within-batch memoization cannot inflate throughput by
-    # collapsing duplicate queries while still counting them at full weight.
-    iters = min(int(os.environ.get("BENCH_ITERS", "128")), n_rows)
+    # Cap batch size at the number of distinct ordered row pairs: every
+    # query in a batch is then distinct, so the engine's within-batch
+    # memoization cannot inflate throughput by collapsing duplicates
+    # while still counting them at full weight.
+    iters = min(int(os.environ.get("BENCH_ITERS", "1024")), n_rows * (n_rows - 1))
 
     platform, probes = _ensure_live_backend()
     device = _device_info()
@@ -582,22 +894,33 @@ def main():
     count_qps, topn_qps = bench_device(ex, n_rows, n_shards, iters)
     host_qps, host_detail = bench_host(holder, n_rows, n_shards, iters)
 
-    pallas = (
-        bench_pallas() if os.environ.get("BENCH_PALLAS") != "0"
-        else {"skipped": "BENCH_PALLAS=0"}
-    )
-    scale = (
-        bench_scale() if os.environ.get("BENCH_SCALE") != "0"
-        else {"skipped": "BENCH_SCALE=0"}
-    )
-    open_stanza = (
-        bench_open() if os.environ.get("BENCH_OPEN") != "0"
-        else {"skipped": "BENCH_OPEN=0"}
-    )
-    serving = (
-        bench_serving() if os.environ.get("BENCH_SERVING") != "0"
-        else {"skipped": "BENCH_SERVING=0"}
-    )
+    def stanza(name, fn):
+        """Run one optional stanza; a crash records the error instead of
+        killing the whole bench line."""
+        if os.environ.get(f"BENCH_{name}") == "0":
+            return {"skipped": f"BENCH_{name}=0"}
+        try:
+            return fn()
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    hbm = stanza("HBM", bench_hbm)
+    scale = stanza("SCALE", bench_scale)
+    open_stanza = stanza("OPEN", bench_open)
+    serving = stanza("SERVING", bench_serving)
+    topn_bsi = stanza("TOPN_BSI", bench_topn_bsi)
+    time_range = stanza("TIME_RANGE", bench_time_range)
+
+    # Kernel-tier verdict derived from the HBM race: the shipped Pallas
+    # kernel must beat the XLA formulation at serving-realistic sizes.
+    if isinstance(hbm, dict) and "gbs" in hbm.get("pallas_gather", {}):
+        pallas = {"batched_gather_expr_count": {
+            "vs_xla": hbm.get("pallas_vs_xla"),
+            "gbs": hbm["pallas_gather"]["gbs"],
+            "verified": hbm.get("verified"),
+        }}
+    else:
+        pallas = {"note": "kernel validation needs a TPU; see detail.hbm"}
 
     print(json.dumps({
         "metric": "count_intersect_qps_8shards",
@@ -615,10 +938,13 @@ def main():
             "platform": device["platform"] if platform == "default" else platform,
             "device": device,
             "probes": probes,
+            "hbm": hbm,
             "pallas": pallas,
             "scale": scale,
             "open": open_stanza,
             "serving": serving,
+            "topn_bsi": topn_bsi,
+            "time_range": time_range,
         },
     }))
 

@@ -485,7 +485,7 @@ class Executor:
             raise QueryError("Count() only accepts a single bitmap input")
         child = c.children[0]
 
-        if self._collective_ok(index, shards, opt) and self.engine.supports(child):
+        if self._collective_ok(index, shards, opt) and self.engine.supports(child, index):
             from .parallel.collective import CollectiveUnavailable
 
             try:
@@ -508,14 +508,23 @@ class Executor:
         call tree compiles onto the fast path; remote/unsupported shards use
         the reference-style per-shard map/reduce."""
         target = child if child is not None else c
-        if shards and self.engine.supports(target):
+        supported = self.engine.supports(target, index) if shards else False
+        if supported:
+            # supports(call, index) returns the compiled (comp, expr) pair,
+            # so the gate and the execution share one AST walk on the
+            # hottest serving path (True means a patched/syntactic gate:
+            # let the engine compile internally).
+            compiled = None if supported is True else supported
+
             def local_runner(local_shards):
                 if kind == "count":
                     co = self.coalescer
                     if co is not None:
                         return co.count(index, target, local_shards)
-                    return self.engine.count(index, target, local_shards)
-                return self.engine.bitmap(index, target, local_shards)
+                    return self.engine.count(
+                        index, target, local_shards, comp_expr=compiled)
+                return self.engine.bitmap(
+                    index, target, local_shards, comp_expr=compiled)
 
             return self._fan_out(index, shards, c, opt, local_runner, reduce_fn)
         return self._map_reduce(index, shards, c, opt, map_fn, reduce_fn)
@@ -545,7 +554,7 @@ class Executor:
 
         if (
             bsig is not None
-            and (filter_call is None or self.engine.supports(filter_call))
+            and (filter_call is None or self.engine.supports(filter_call, index))
             and self._collective_ok(index, shards, opt)
         ):
             from .parallel.collective import CollectiveUnavailable
@@ -561,7 +570,7 @@ class Executor:
 
         local_runner = None
         if bsig is not None and (
-            filter_call is None or self.engine.supports(filter_call)
+            filter_call is None or self.engine.supports(filter_call, index)
         ):
             # Batched path: one device program per node covering all its
             # shards (replaces the per-shard ValCount merge loop).
@@ -668,7 +677,7 @@ class Executor:
             and not c.args.get("attrName")
             and not tanimoto
             and max(c.uint_arg("threshold")[0], DEFAULT_MIN_THRESHOLD) <= 1
-            and (src_call is None or self.engine.supports(src_call))
+            and (src_call is None or self.engine.supports(src_call, index))
             and self._collective_ok(index, shards, opt)
         ):
             # Collective phase-2: global candidate counts in one SPMD
@@ -699,7 +708,7 @@ class Executor:
             ids
             and not c.args.get("attrName")
             and src_call is not None  # without src the host rank cache has
-            and self.engine.supports(src_call)  # exact counts; device adds RTT
+            and self.engine.supports(src_call, index)  # exact counts; device adds RTT
         ):
             # Batched phase-2: all candidate counts across all local shards
             # in one device program, preserving per-shard MinThreshold and
@@ -738,7 +747,7 @@ class Executor:
         elif (
             src_call is not None
             and not ids
-            and self.engine.supports(src_call)
+            and self.engine.supports(src_call, index)
         ):
             # Batched phase-1: each shard's candidate list comes from its
             # host rank cache (cheap), but the src intersections for the

@@ -93,7 +93,59 @@ class _Compiler:
             return fn
         if c.name == "Range" and c.has_condition_arg():
             return self._compile_bsi_range(c)
+        if c.name == "Range":
+            return self._compile_time_range(c)
         raise QueryError(f"not fast-path compilable: {c.name}")
+
+    def _compile_time_range(self, c: Call) -> Callable:
+        """Time-quantum Range as a fast-path union over time-view leaves.
+
+        The executor's per-shard fallback merges one view at a time
+        (executor.py:_execute_time_range_shard, reference
+        executor.go:executeBitmapCallShard + fragment row per view); here
+        the whole view set becomes leaf planes of ONE compiled program, so
+        Count(Range(t=...)) over all shards is a single device dispatch
+        and composes with Intersect/Union/TopN-src like any other leaf."""
+        from ..timeq import parse_timestamp, views_by_time_range
+
+        field_name = c.field_arg()
+        fld = self.holder.field(self.index, field_name)
+        if fld is None:
+            raise FieldNotFoundError(field_name)
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise QueryError("Range() must specify row")
+        start = c.args.get("_start")
+        end = c.args.get("_end")
+        if not isinstance(start, str) or not isinstance(end, str):
+            raise QueryError("Range() start/end time required")
+        q = fld.time_quantum()
+        if not q:
+            raise QueryError("Range() field has no time quantum")
+        views = views_by_time_range(
+            VIEW_STANDARD, parse_timestamp(start), parse_timestamp(end), q
+        )
+        # Prune to views that exist in the field: an hour-quantum range
+        # over years enumerates tens of thousands of view names, and a
+        # leaf per ABSENT view would materialize a zero plane per shard
+        # (the per-shard fallback just skips missing fragments). Present
+        # views bound the work to actual data; an empty result refuses so
+        # supports() sends the executor down the fallback.
+        views = [v for v in views if fld.view(v) is not None]
+        if not views:
+            raise QueryError("Range() covers no populated views")
+        if len(views) > 256:
+            raise QueryError("Range() spans too many views for the fast path")
+        idxs = [self.leaf_index(Leaf(field_name, v, row_id)) for v in views]
+        self.signature.append(("timerange", tuple(idxs)))
+
+        def fn(leaves):
+            out = leaves[idxs[0]]
+            for i in idxs[1:]:
+                out = jnp.bitwise_or(out, leaves[i])
+            return out
+
+        return fn
 
     def _compile_bsi_range(self, c: Call) -> Callable:
         (field_name, cond), = c.args.items()
@@ -168,9 +220,16 @@ class ShardedQueryEngine:
         # Device-cache budgets (bytes, LRU-evicted). The stacked tensors
         # duplicate the per-leaf planes they're built from, so both caches
         # need a byte bound, not an entry bound — one TopN candidate list
-        # can be 1000x the size of a 2-leaf count stack.
-        self._leaf_budget = int(os.environ.get("PILOSA_LEAF_CACHE_BYTES", 1 << 29))
-        self._stack_budget = int(os.environ.get("PILOSA_STACK_CACHE_BYTES", 1 << 28))
+        # can be 1000x the size of a 2-leaf count stack. Defaults are
+        # sized for a serving chip (v5e: 16 GiB HBM): a 256-candidate x
+        # 8-shard TopN stack alone is ~268 MiB, so sub-GiB budgets thrash
+        # on every ranked-cache TopN.
+        on_accel = self.mesh.devices.flat[0].platform in ("tpu", "axon")
+        default_budget = (3 << 30) if on_accel else (1 << 29)
+        self._leaf_budget = int(
+            os.environ.get("PILOSA_LEAF_CACHE_BYTES", default_budget))
+        self._stack_budget = int(
+            os.environ.get("PILOSA_STACK_CACHE_BYTES", default_budget))
         self._stack_jit: Optional[Callable] = None
         self._count_fns: Dict[Tuple, Callable] = {}
         self._bitmap_fns: Dict[Tuple, Callable] = {}
@@ -433,10 +492,11 @@ class ShardedQueryEngine:
         expr = comp.compile(call)
         return comp, expr
 
-    def count(self, index: str, call: Call, shards: Sequence[int]) -> int:
+    def count(self, index: str, call: Call, shards: Sequence[int],
+              comp_expr=None) -> int:
         """Count(<bitmap call>) over all shards in one device program."""
         shards = tuple(shards)
-        comp, expr = self._compile(index, call)
+        comp, expr = comp_expr if comp_expr is not None else self._compile(index, call)
         hit, token = self.memo_probe(index, comp, shards)
         if hit is not None:
             return hit
@@ -660,11 +720,12 @@ class ShardedQueryEngine:
 
         return pk._on_tpu() and WORDS_PER_ROW % 128 == 0
 
-    def bitmap(self, index: str, call: Call, shards: Sequence[int]) -> Row:
+    def bitmap(self, index: str, call: Call, shards: Sequence[int],
+               comp_expr=None) -> Row:
         """Evaluate a bitmap call over all shards; returns a Row whose
         segments stay on device (one (W,) plane per shard)."""
         shards = tuple(shards)
-        comp, expr = self._compile(index, call)
+        comp, expr = comp_expr if comp_expr is not None else self._compile(index, call)
         sig = ("bitmap", tuple(comp.signature), len(shards))
         fn = self._fn_build(self._bitmap_fns, sig, lambda: jax.jit(expr))
         leaves = self._leaf_tensor(index, comp.leaves, shards)
@@ -847,11 +908,24 @@ class ShardedQueryEngine:
         bits, count = out
         return np.asarray(bits), int(count)
 
-    def supports(self, call: Call) -> bool:
-        """True if `call` compiles onto the fast path."""
+    def supports(self, call: Call, index: Optional[str] = None):
+        """Truthy if `call` compiles onto the fast path.
+
+        With `index`, runs the REAL compiler (holder lookups, no device
+        work) so the answer is exact — e.g. a time-quantum Range only
+        compiles when the field actually has a quantum and the range
+        covers views; the syntactic check alone would claim support and
+        then diverge from the fallback's empty-Row semantics. The return
+        value is then the compiled (comp, expr) pair, which callers pass
+        to count()/bitmap() as comp_expr so the gate and the execution
+        share ONE AST walk. Without `index` (callers that don't know it
+        yet) the check is syntactic (returns True) and time Ranges are
+        conservatively refused. Falsy (False) when not supported."""
         try:
-            self._compile_check(call)
-            return True
+            if index is None:
+                self._compile_check(call)
+                return True
+            return self._compile(index, call)
         except Exception:
             return False
 

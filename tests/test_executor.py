@@ -137,12 +137,12 @@ def test_topn_with_src_batched_matches_fallback(holder, ex):
     real_supports = ex.engine.supports
     src_ast = None
 
-    def no_src_supports(call):
+    def no_src_supports(call, *a, **kw):
         # Refuse only the src Row so the executor takes the per-fragment
         # fallback; the phase-2 refetch path is disabled the same way.
         if call.name == "Row" and call.args.get("g") is not None:
             return False
-        return real_supports(call)
+        return real_supports(call, *a, **kw)
 
     ex.engine.supports = no_src_supports
     try:
@@ -157,10 +157,10 @@ def _force_fallback_topn(ex, q, src_field="g"):
     per-fragment TopN fallback (the semantic oracle for the batched path)."""
     real_supports = ex.engine.supports
 
-    def no_src_supports(call):
+    def no_src_supports(call, *a, **kw):
         if call.name == "Row" and call.args.get(src_field) is not None:
             return False
-        return real_supports(call)
+        return real_supports(call, *a, **kw)
 
     ex.engine.supports = no_src_supports
     try:
@@ -288,6 +288,62 @@ def test_time_range(holder, ex):
     assert list(row.columns()) == [1, 2, 3]
     # Standard view still has all bits.
     assert list(ex.execute("t", "Row(f=1)")[0].columns()) == [1, 2, 3]
+
+
+def test_time_range_fast_path_matches_fallback(holder, ex):
+    """Time-quantum Range compiles onto the engine fast path (union over
+    time-view leaves, ONE device program across shards) — results must be
+    identical to the per-shard per-view merge fallback
+    (executor.py:_execute_time_range_shard), incl. composed in Intersect
+    and as a Count input."""
+    idx = holder.create_index_if_not_exists("tt")
+    idx.create_field_if_not_exists("f", FieldOptions(type="time", time_quantum="YMD"))
+    idx.create_field_if_not_exists("g")
+    for day in range(1, 9):
+        for col in (day, SHARD_WIDTH + day, 100 + day):
+            ex.execute("tt", f"Set({col}, f=1, 2018-03-{day:02d}T00:00)")
+    for col in (2, 3, 103, SHARD_WIDTH + 4):
+        ex.execute("tt", f"Set({col}, g=9)")
+
+    queries = [
+        "Range(f=1, 2018-03-02T00:00, 2018-03-06T00:00)",
+        "Count(Range(f=1, 2018-03-02T00:00, 2018-03-06T00:00))",
+        "Intersect(Range(f=1, 2018-03-01T00:00, 2018-03-08T00:00), Row(g=9))",
+        "Count(Union(Range(f=1, 2018-03-01T00:00, 2018-03-03T00:00), Row(g=9)))",
+    ]
+
+    def run_all():
+        out = []
+        for q in queries:
+            r = ex.execute("tt", q)[0]
+            out.append(list(r.columns()) if hasattr(r, "columns") else r)
+        return out
+
+    got = run_all()
+    real_supports = ex.engine.supports
+
+    def no_range_supports(call, *a, **kw):
+        if call.name == "Range":
+            return False
+        return real_supports(call, *a, **kw)
+
+    ex.engine.supports = no_range_supports
+    try:
+        want = run_all()
+    finally:
+        ex.engine.supports = real_supports
+    assert got == want, (got, want)
+    assert got[1] == 12  # 4 days (end-exclusive) x 3 cols: non-vacuous
+
+    # supports() with the index is exact: a non-time field refuses (the
+    # fallback returns an empty Row there; claiming support would raise).
+    from pilosa_tpu.pql.parser import parse
+
+    bad = parse("Range(g=1, 2018-03-01T00:00, 2018-03-02T00:00)").calls[0]
+    assert not ex.engine.supports(bad, "tt")
+    good = parse("Range(f=1, 2018-03-01T00:00, 2018-03-02T00:00)").calls[0]
+    assert ex.engine.supports(good, "tt")
+    assert not ex.engine.supports(good)  # syntactic-only: refused
 
 
 def test_row_attrs(holder, ex):

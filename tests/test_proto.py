@@ -165,3 +165,57 @@ def test_encode_decode_helpers():
     assert decoded[3][0].key == "k"
     assert decoded[4].val == 10
     assert decoded[5] is None
+
+
+def test_golden_query_response_bytes():
+    """Pin the public QueryResponse WIRE BYTES against the reference
+    schema (internal/public.proto:56-69 QueryResponse/QueryResult, field
+    numbers and proto3 packed/varint rules applied by hand below). The
+    internal node-to-node plane is deliberately NOT reference-compatible
+    (docs/architecture.md "Interoperability"); this golden guarantees the
+    PUBLIC plane stays byte-compatible — a reference protobuf client must
+    parse our responses forever."""
+    from pilosa_tpu.core.cache import Pair
+    from pilosa_tpu.core.row import Row
+    from pilosa_tpu.executor import ValCount
+
+    row = Row(columns=[1, 2**20 + 1])  # crosses a varint width
+    got = encode_query_response([7, row, [Pair(id=10, count=3)],
+                                 ValCount(val=-2, count=4), True])
+
+    golden = bytes.fromhex(
+        # QueryResponse.Results is field 2 (tag 0x12), one length-
+        # delimited QueryResult each. QueryResult fields: Row=1, N=2,
+        # Pairs=3, Changed=4, ValCount=5, Type=6 (serialized in field-
+        # number order by canonical protobuf encoders).
+        "12 04"        # Results[0], 4 bytes: Count result
+        "10 07"        #   N=7        (field 2 varint)
+        "30 04"        #   Type=4     (TYPE_UINT64, http/handler.go tag)
+        "12 0a"        # Results[1], 10 bytes: Row result
+        "0a 06"        #   Row=       (field 1, message, 6 bytes)
+        "0a 04"        #     Columns= (field 1, packed varints, 4 bytes)
+        "01"           #       1
+        "81 80 40"     #       1048577 = 2^20+1 as varint
+        "30 01"        #   Type=1     (TYPE_ROW)
+        "12 08"        # Results[2], 8 bytes: Pairs result
+        "1a 04"        #   Pairs[0]=  (field 3, message, 4 bytes)
+        "08 0a"        #     ID=10    (field 1 varint)
+        "10 03"        #     Count=3  (field 2 varint)
+        "30 02"        #   Type=2     (TYPE_PAIRS)
+        "12 11"        # Results[3], 17 bytes: ValCount result
+        "2a 0d"        #   ValCount=  (field 5, message, 13 bytes)
+        "08" + "fe" + "ff" * 8 + "01"       # Val=-2 (int64 varint, 10B)
+        "10 04"        #     Count=4  (field 2 varint)
+        "30 03"        #   Type=3     (TYPE_VALCOUNT)
+        "12 04"        # Results[4], 4 bytes: Changed result
+        "20 01"        #   Changed=true (field 4 varint)
+        "30 05"        #   Type=5     (TYPE_BOOL)
+        .replace(" ", "")
+    )
+    assert got == golden, (got.hex(), golden.hex())
+    # And it round-trips through the decoder.
+    decoded = pb.QueryResponse()
+    decoded.ParseFromString(got)
+    assert decoded.Results[0].N == 7
+    assert list(decoded.Results[1].Row.Columns) == [1, 2**20 + 1]
+    assert decoded.Results[3].ValCount.Val == -2
