@@ -604,7 +604,12 @@ class Fragment:
     # ---------------------------------------------------------- persistence
 
     def snapshot(self) -> None:
-        """Rewrite the storage file without the op log (fragment.go:1399-1469)."""
+        """Rewrite the storage file without the op log (fragment.go:1399-1469).
+
+        Also re-compresses RLE-heavy containers to the run form (reference
+        Optimize) so point-mutation churn between snapshots doesn't leave
+        8 KiB bitsets where 4-byte interval lists suffice."""
+        self.storage.optimize()
         if not self.path:
             self.op_n = 0
             return

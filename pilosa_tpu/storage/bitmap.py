@@ -8,15 +8,19 @@ fragments materialize dense uint32 bitplanes in HBM (see ops/bitplane.py);
 this class exists for persistence, imports, WAL replay, and as a numpy
 oracle for kernel tests.
 
-Containers are two-way, mirroring the reference's array/bitmap split
-(roaring/roaring.go:988-1061): a sorted np.uint16 array while sparse
-(≤4096 values, ≤8KiB) and a 1024-word uint64 bitset once dense (8KiB flat,
-O(1) point ops) — the run form exists only on the wire, chosen at
-serialization time when it is the smallest encoding (any roaring reader,
-including the reference's, accepts all three). The dense form is what lets
-imports of billions of bits run at memory bandwidth instead of O(n) numpy
-inserts, and lets row planes be assembled by copying words instead of
-re-packing value lists.
+Containers are three-way, mirroring the reference's array/bitmap/run
+forms (roaring/roaring.go:988-1061): a sorted np.uint16 array while sparse
+(≤4096 values, ≤8KiB), a 1024-word uint64 bitset once dense (8KiB flat,
+O(1) point ops), and an (R, 2) [start, last] run-interval array for
+RLE-heavy data — a fully-set container is 4 bytes of runs instead of 8KiB,
+so adversarial imports of huge contiguous ranges stay memory-bounded
+(reference computes on runs too, roaring.go:1906-1949). Runs are a
+compute+memory form here: count/contains/range/intersection-count operate
+on intervals directly; point mutations convert to the flat forms
+(re-runified on the next bulk op or optimize()). The dense form is what
+lets imports of billions of bits run at memory bandwidth instead of O(n)
+numpy inserts, and lets row planes be assembled by copying words instead
+of re-packing value lists.
 """
 
 from __future__ import annotations
@@ -85,17 +89,68 @@ def _in_bits(words: np.ndarray, arr: np.ndarray) -> np.ndarray:
     return (words[idx >> 6] >> (idx & np.uint32(63)).astype(np.uint64)) & _WORD_ONE != 0
 
 
-class Container:
-    """One 2^16-bit block: sorted uint16 array (sparse) or uint64 bitset
-    (dense). `n` is always the exact cardinality."""
 
-    __slots__ = ("arr", "bits", "n", "nv")
+
+def _runs_of_array(c: np.ndarray) -> np.ndarray:
+    """Sorted uint16 values -> (r, 2) [start, last] inclusive run pairs."""
+    if len(c) == 0:
+        return np.empty((0, 2), dtype=np.uint16)
+    brk = np.flatnonzero(np.diff(c.astype(np.int32)) != 1)
+    starts = np.concatenate(([0], brk + 1))
+    lasts = np.concatenate((brk, [len(c) - 1]))
+    return np.stack([c[starts], c[lasts]], axis=1)
+
+
+def _runs_n(runs: np.ndarray) -> int:
+    return int((runs[:, 1].astype(np.int64) - runs[:, 0] + 1).sum())
+
+
+def _runs_to_arr(runs: np.ndarray) -> np.ndarray:
+    if len(runs) == 0:
+        return _empty()
+    return np.concatenate(
+        [np.arange(int(s), int(l) + 1, dtype=np.uint32) for s, l in runs]
+    ).astype(np.uint16)
+
+
+def _runs_to_words(runs: np.ndarray) -> np.ndarray:
+    bools = np.zeros(1 << 16, dtype=bool)
+    for s, l in runs:
+        bools[int(s) : int(l) + 1] = True
+    return np.packbits(bools, bitorder="little").view(np.uint64).copy()
+
+
+def _bits_run_count(words: np.ndarray) -> int:
+    """Number of runs in a bitset = popcount of run-start bits (a set bit
+    whose predecessor is clear), without materializing the value list."""
+    shifted = (words << _WORD_ONE) | np.concatenate(
+        ([np.uint64(0)], words[:-1] >> np.uint64(63))
+    )
+    return _popcount(words & ~shifted)
+
+
+
+class Container:
+    """One 2^16-bit block: sorted uint16 array (sparse), uint64 bitset
+    (dense), or (r, 2) [start, last] run intervals (RLE-heavy). `n` is
+    always the exact cardinality."""
+
+    __slots__ = ("arr", "bits", "runs", "n", "nv")
 
     def __init__(self, arr: Optional[np.ndarray] = None,
-                 bits: Optional[np.ndarray] = None, n: Optional[int] = None):
+                 bits: Optional[np.ndarray] = None, n: Optional[int] = None,
+                 runs: Optional[np.ndarray] = None):
         self.arr = arr
         self.bits = bits
-        self.n = (len(arr) if arr is not None else _popcount(bits)) if n is None else n
+        self.runs = runs
+        if n is None:
+            if arr is not None:
+                n = len(arr)
+            elif runs is not None:
+                n = _runs_n(runs)
+            else:
+                n = _popcount(bits)
+        self.n = n
         # n-verified: False only for lazily-opened bitset containers whose
         # header cardinality was trusted without paging in the payload
         # (Bitmap.from_buffer copy=False); verify_n() settles it on first use.
@@ -123,20 +178,37 @@ class Container:
 
     @classmethod
     def from_sorted(cls, arr: np.ndarray) -> "Container":
-        """From a sorted unique uint16 array; picks the right form."""
+        """From a sorted unique uint16 array; picks the right form
+        (including runs when at most half the flat size)."""
         if len(arr) > ARRAY_MAX_SIZE:
-            return cls(bits=_arr_to_words(arr), n=len(arr))
-        return cls(arr=np.ascontiguousarray(arr, dtype=np.uint16))
+            c = cls(bits=_arr_to_words(arr), n=len(arr))
+        else:
+            c = cls(arr=np.ascontiguousarray(arr, dtype=np.uint16))
+        c._maybe_runify()
+        return c
 
     # --------------------------------------------------------------- views
 
     def to_array(self) -> np.ndarray:
-        """Sorted uint16 values (materializes from a bitset)."""
-        return self.arr if self.arr is not None else _words_to_arr(self.bits)
+        """Sorted uint16 values (materializes from a bitset / runs)."""
+        if self.arr is not None:
+            return self.arr
+        if self.runs is not None:
+            return _runs_to_arr(self.runs)
+        return _words_to_arr(self.bits)
 
     def as_words(self) -> np.ndarray:
-        """1024-word uint64 bitset view (materializes from an array)."""
-        return self.bits if self.bits is not None else _arr_to_words(self.arr)
+        """1024-word uint64 bitset view (materializes from array / runs)."""
+        if self.bits is not None:
+            return self.bits
+        if self.runs is not None:
+            return _runs_to_words(self.runs)
+        return _arr_to_words(self.arr)
+
+    def run_pairs(self) -> np.ndarray:
+        """(r, 2) [start, last] inclusive run view (computed for flat
+        forms; free for run containers)."""
+        return self.runs if self.runs is not None else _runs_of_array(self.to_array())
 
     # ----------------------------------------------------- form management
 
@@ -153,6 +225,41 @@ class Container:
             self.arr = _words_to_arr(self.bits)
             self.bits = None
 
+    def _flatten_runs(self) -> None:
+        """Convert the run form to array/bitset before a point mutation.
+        Deliberately NOT re-runified here: WAL replay applies ops one at a
+        time, and converting back per op would be O(n) per bit. Bulk ops
+        and optimize() re-compress."""
+        if self.runs is None:
+            return
+        if self.n <= ARRAY_MAX_SIZE:
+            self.arr = _runs_to_arr(self.runs)
+        else:
+            self.bits = _runs_to_words(self.runs)
+        self.runs = None
+
+    def _maybe_runify(self) -> None:
+        """Adopt the run form when it is at most half the size of the
+        current form (hysteresis, like _maybe_sparsify) — a fully-set
+        container drops from 8 KiB to 4 bytes, which is what keeps
+        adversarial contiguous imports memory-bounded."""
+        if self.runs is not None or self.n == 0:
+            return
+        if self.arr is not None:
+            cur_bytes = 2 * self.n
+            runs = _runs_of_array(self.arr)
+            r = len(runs)
+        else:
+            if not self.nv:
+                return  # lazily-opened: don't page in to maybe-compress
+            cur_bytes = 8 * BITMAP_N
+            runs = None
+            r = _bits_run_count(self.bits)  # cheap; no value list yet
+        if r <= RUN_MAX_SIZE and 4 * r * 2 <= cur_bytes:
+            self.runs = runs if runs is not None else _runs_of_array(self.to_array())
+            self.arr = None
+            self.bits = None
+
     def _mutable_bits(self) -> np.ndarray:
         """Copy-on-write: bitset payloads parsed zero-copy from an mmap (or
         bytes) are read-only views; the first in-place mutation promotes
@@ -165,6 +272,10 @@ class Container:
 
     def add(self, low: int) -> bool:
         self.verify_n()
+        if self.runs is not None:
+            if self.contains(low):
+                return False
+            self._flatten_runs()
         if self.bits is not None:
             w, b = low >> 6, np.uint64(low & 63)
             if (self.bits[w] >> b) & _WORD_ONE:
@@ -183,6 +294,10 @@ class Container:
 
     def remove(self, low: int) -> bool:
         self.verify_n()
+        if self.runs is not None:
+            if not self.contains(low):
+                return False
+            self._flatten_runs()
         if self.bits is not None:
             w, b = low >> 6, np.uint64(low & 63)
             if not (self.bits[w] >> b) & _WORD_ONE:
@@ -200,6 +315,9 @@ class Container:
         return True
 
     def contains(self, low: int) -> bool:
+        if self.runs is not None:
+            i = int(np.searchsorted(self.runs[:, 0], np.uint16(low), "right")) - 1
+            return i >= 0 and low <= int(self.runs[i, 1])
         if self.bits is not None:
             return bool((self.bits[low >> 6] >> np.uint64(low & 63)) & _WORD_ONE)
         i = int(np.searchsorted(self.arr, np.uint16(low)))
@@ -210,6 +328,7 @@ class Container:
     def add_sorted(self, chunk: np.ndarray) -> None:
         """Union in a sorted unique uint16 chunk."""
         self.verify_n()
+        self._flatten_runs()
         if self.bits is None and self.n + len(chunk) > ARRAY_MAX_SIZE:
             self._force_densify()
         if self.bits is not None:
@@ -220,9 +339,11 @@ class Container:
             self.arr = np.union1d(self.arr, chunk)
             self.n = len(self.arr)
             self._maybe_densify()
+        self._maybe_runify()
 
     def remove_sorted(self, chunk: np.ndarray) -> None:
         self.verify_n()
+        self._flatten_runs()
         if self.bits is not None:
             bits = self._mutable_bits()
             bits &= ~_arr_to_words(chunk)
@@ -231,6 +352,7 @@ class Container:
         else:
             self.arr = np.setdiff1d(self.arr, chunk, assume_unique=True)
             self.n = len(self.arr)
+        self._maybe_runify()
 
     def _force_densify(self) -> None:
         self.bits = _arr_to_words(self.arr)
@@ -243,6 +365,11 @@ class Container:
         if lo <= 0 and hi >= 1 << 16:
             self.verify_n()
             return self.n
+        if self.runs is not None:
+            s = self.runs[:, 0].astype(np.int64)
+            l = self.runs[:, 1].astype(np.int64)
+            overlap = np.minimum(l, hi - 1) - np.maximum(s, lo) + 1
+            return int(overlap[overlap > 0].sum())
         if self.arr is not None:
             i = np.searchsorted(self.arr, np.uint16(lo)) if lo > 0 else 0
             j = np.searchsorted(self.arr, np.uint16(hi)) if hi < (1 << 16) else len(self.arr)
@@ -268,60 +395,119 @@ class Container:
 
     def intersection_count(self, other: "Container") -> int:
         a, b = self, other
+        if a.runs is not None or b.runs is not None:
+            return self._intersection_count_runs(other)
         if a.bits is not None and b.bits is not None:
             return _popcount(a.bits & b.bits)
-        if a.bits is None and b.bits is None:
+        if a.arr is not None and b.arr is not None:
             from .. import native
 
             if native.available():
                 return native.intersection_count_u16(a.arr, b.arr)
             return len(np.intersect1d(a.arr, b.arr, assume_unique=True))
-        arr, bits = (a.arr, b.bits) if a.bits is None else (b.arr, a.bits)
+        arr, bits = (a.arr, b.bits) if a.arr is not None else (b.arr, a.bits)
         return int(np.count_nonzero(_in_bits(bits, arr))) if len(arr) else 0
+
+    def _intersection_count_runs(self, other: "Container") -> int:
+        """Run-aware |a ∩ b| without materializing either side, the
+        in-memory analog of the reference's intersectionCount*Run family
+        (roaring.go:1906-1949): run x run sums clipped interval overlaps
+        over the (linear) set of overlapping run pairs; run x array is a
+        vectorized interval membership test; run x bitset clips per-run
+        word popcounts."""
+        a, b = self, other
+        if a.runs is None:
+            a, b = b, a  # a has runs now
+        if b.runs is not None:
+            ra, rb = a.runs, b.runs
+            if len(ra) == 0 or len(rb) == 0:
+                return 0
+            # For each a-run, the b-runs overlapping it are a contiguous
+            # span [jlo, jhi); total overlapping pairs is O(Ra + Rb).
+            jlo = np.searchsorted(rb[:, 1], ra[:, 0], "left")
+            jhi = np.searchsorted(rb[:, 0], ra[:, 1], "right")
+            reps = (jhi - jlo).clip(min=0)
+            ai = np.repeat(np.arange(len(ra)), reps)
+            bi = np.concatenate(
+                [np.arange(l, h) for l, h in zip(jlo, jhi) if h > l]
+            ) if reps.sum() else np.empty(0, dtype=np.int64)
+            if len(ai) == 0:
+                return 0
+            s = np.maximum(ra[ai, 0].astype(np.int64), rb[bi, 0].astype(np.int64))
+            l = np.minimum(ra[ai, 1].astype(np.int64), rb[bi, 1].astype(np.int64))
+            overlap = l - s + 1
+            return int(overlap[overlap > 0].sum())
+        if b.arr is not None:
+            arr = b.arr
+            if len(arr) == 0 or len(a.runs) == 0:
+                return 0
+            i = np.searchsorted(a.runs[:, 0], arr, "right") - 1
+            ok = i >= 0
+            ok[ok] &= arr[ok] <= a.runs[i[ok], 1]
+            return int(np.count_nonzero(ok))
+        # runs x bitset: clip each run's words against the bitset.
+        total = 0
+        words = b.bits
+        for s, l in a.runs:
+            s, l = int(s), int(l)
+            wl, wh = s >> 6, (l >> 6) + 1
+            chunk = words[wl:wh].copy()
+            if s & 63:
+                chunk[0] &= ~np.uint64(0) << np.uint64(s & 63)
+            if (l & 63) != 63:
+                chunk[-1] &= (_WORD_ONE << np.uint64((l & 63) + 1)) - _WORD_ONE
+            total += _popcount(chunk)
+        return total
 
     def _binop_words(self, other: "Container", op) -> "Container":
         words = op(self.as_words(), other.as_words())
         n = _popcount(words)
         if n <= ARRAY_MAX_SIZE:
-            return Container(arr=_words_to_arr(words), n=n)
-        return Container(bits=words, n=n)
+            c = Container(arr=_words_to_arr(words), n=n)
+        else:
+            c = Container(bits=words, n=n)
+        c._maybe_runify()
+        return c
 
     def union(self, other: "Container") -> "Container":
-        if self.bits is None and other.bits is None:
+        if self.arr is not None and other.arr is not None:
             return Container.from_sorted(_np_or_native("union_u16", np.union1d)(self.arr, other.arr))
         return self._binop_words(other, np.bitwise_or)
 
     def intersect(self, other: "Container") -> "Container":
-        if self.bits is None and other.bits is None:
+        if self.arr is not None and other.arr is not None:
             fn = _np_or_native(
                 "intersect_u16", lambda a, b: np.intersect1d(a, b, assume_unique=True)
             )
             return Container.from_sorted(fn(self.arr, other.arr))
-        if self.bits is None or other.bits is None:
-            arr, bits = (self.arr, other.bits) if self.bits is None else (other.arr, self.bits)
+        if self.arr is not None or other.arr is not None:
+            arr, dense = (self.arr, other) if self.arr is not None else (other.arr, self)
+            bits = dense.as_words()
             return Container.from_sorted(arr[_in_bits(bits, arr)] if len(arr) else _empty())
         return self._binop_words(other, np.bitwise_and)
 
     def difference(self, other: "Container") -> "Container":
-        if self.bits is None:
-            if other.bits is None:
+        if self.arr is not None:
+            if other.arr is not None:
                 fn = _np_or_native(
                     "difference_u16", lambda a, b: np.setdiff1d(a, b, assume_unique=True)
                 )
                 return Container.from_sorted(fn(self.arr, other.arr))
             return Container.from_sorted(
-                self.arr[~_in_bits(other.bits, self.arr)] if len(self.arr) else _empty()
+                self.arr[~_in_bits(other.as_words(), self.arr)] if len(self.arr) else _empty()
             )
         return self._binop_words(other, lambda a, b: a & ~b)
 
     def xor(self, other: "Container") -> "Container":
-        if self.bits is None and other.bits is None:
+        if self.arr is not None and other.arr is not None:
             return Container.from_sorted(_np_or_native("xor_u16", np.setxor1d)(self.arr, other.arr))
         return self._binop_words(other, np.bitwise_xor)
 
     # ------------------------------------------------------------- plumbing
 
     def copy(self) -> "Container":
+        if self.runs is not None:
+            return Container(runs=self.runs.copy(), n=self.n)
         if self.bits is not None:
             c = Container(bits=self.bits.copy(), n=self.n)
             c.nv = self.nv  # an unverified n must not launder through a copy
@@ -345,6 +531,22 @@ class Container:
 
     def check(self, key) -> List[str]:
         problems = []
+        if self.runs is not None:
+            r = self.runs
+            if len(r) == 0:
+                problems.append(f"{key}: empty container present")
+                return problems
+            if self.n != _runs_n(r):
+                problems.append(f"{key}: cardinality {self.n} != run total")
+            s = r[:, 0].astype(np.int64)
+            l = r[:, 1].astype(np.int64)
+            if np.any(l < s):
+                problems.append(f"{key}: run with last < start")
+            # Consecutive runs must be ascending AND non-adjacent (adjacent
+            # runs should have been coalesced into one).
+            if len(r) > 1 and np.any(s[1:] <= l[:-1] + 1):
+                problems.append(f"{key}: runs overlapping or adjacent")
+            return problems
         if self.bits is not None:
             if len(self.bits) != BITMAP_N:
                 problems.append(f"{key}: bitset has {len(self.bits)} words")
@@ -692,16 +894,6 @@ class Bitmap:
 
     # ---------------------------------------------------------- serialization
 
-    @staticmethod
-    def _runs(c: np.ndarray) -> np.ndarray:
-        """Sorted uint16 array -> (r, 2) [start, last] inclusive run pairs."""
-        if len(c) == 0:
-            return np.empty((0, 2), dtype=np.uint16)
-        brk = np.flatnonzero(np.diff(c.astype(np.int32)) != 1)
-        starts = np.concatenate(([0], brk + 1))
-        lasts = np.concatenate((brk, [len(c) - 1]))
-        return np.stack([c[starts], c[lasts]], axis=1)
-
     def to_bytes(self) -> bytes:
         items = sorted(
             (k, _as_container(c)) for k, c in self.containers.items() if len(_as_container(c))
@@ -709,7 +901,9 @@ class Bitmap:
         buf = io.BytesIO()
         buf.write(struct.pack("<II", COOKIE, len(items)))
 
-        # Pick the smallest of array / bitmap / run per container.
+        # Pick the smallest of array / bitmap / run per container. Run
+        # containers reuse their in-memory intervals directly (no value
+        # list is ever materialized for, e.g., a fully-set container).
         payloads = []
         for key, cont in items:
             # A lazy-opened container may still carry a header-trusted n;
@@ -718,8 +912,7 @@ class Bitmap:
             # misparses the tail as op-log). Settle it now.
             cont.verify_n()
             n = cont.n
-            arr = cont.to_array()
-            runs = self._runs(arr)
+            runs = cont.run_pairs()
             sizes = {
                 CONTAINER_ARRAY: 2 * n,
                 CONTAINER_BITMAP: 8 * BITMAP_N,
@@ -731,7 +924,7 @@ class Bitmap:
                 del sizes[CONTAINER_ARRAY]
             typ = min(sizes, key=lambda t: (sizes[t], t))
             if typ == CONTAINER_ARRAY:
-                data = arr.astype("<u2").tobytes()
+                data = cont.to_array().astype("<u2").tobytes()
             elif typ == CONTAINER_RUN:
                 data = struct.pack("<H", len(runs)) + runs.astype("<u2").tobytes()
             else:
@@ -811,11 +1004,25 @@ class Bitmap:
                 if run_n == 0:
                     c = Container(arr=_empty(), n=0)
                 else:
-                    # int() casts: a run ending at 65535 must not wrap uint16.
-                    arr = np.concatenate(
-                        [np.arange(int(s), int(l) + 1, dtype=np.uint32) for s, l in runs]
-                    ).astype(np.uint16)
-                    c = Container.from_sorted(arr)
+                    # Runs STAY runs in memory (a fully-set container is 4
+                    # bytes, not 8 KiB); cardinality is derived from the
+                    # intervals, so the header n can't poison count math —
+                    # but the intervals themselves must be validated, or a
+                    # corrupt/hostile file (inverted, unsorted, or
+                    # overlapping runs) silently breaks count and
+                    # binary-search membership math.
+                    s = runs[:, 0].astype(np.int64)
+                    l = runs[:, 1].astype(np.int64)
+                    if np.any(l < s) or (
+                        run_n > 1 and np.any(s[1:] <= l[:-1])
+                    ):
+                        raise ValueError(
+                            f"corrupt run container at key {key}: intervals "
+                            "inverted, unsorted, or overlapping"
+                        )
+                    if copy:
+                        runs = runs.astype(np.uint16)
+                    c = Container(runs=runs)
                 n = c.n
                 ops_offset = max(ops_offset, off + 2 + 4 * run_n)
             else:
@@ -841,6 +1048,17 @@ class Bitmap:
         data = self.to_bytes()
         f.write(data)
         return len(data)
+
+    def optimize(self) -> None:
+        """Adopt the run form wherever it at least halves a container's
+        memory (reference roaring.go Optimize). Called at snapshot time so
+        point-mutation churn between snapshots re-compresses."""
+        for k in list(self.containers):
+            c = _as_container(self.containers[k])
+            before = c.runs is None
+            c._maybe_runify()
+            if before and c.runs is not None:
+                self.containers[k] = c  # write back for factory stores
 
     def check(self) -> List[str]:
         """Consistency check (reference roaring.go:745 Bitmap.Check /

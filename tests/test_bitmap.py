@@ -306,3 +306,154 @@ def test_corrupt_container_keeps_raising_and_wont_serialize():
     lazy2 = Bitmap.from_buffer(bytes(data), copy=False)
     with pytest.raises(ValueError, match="corrupt"):
         lazy2.clone().count()
+
+
+# ------------------------------------------------------- run form (in-memory)
+
+
+def test_run_container_is_compute_form():
+    """Runs are a compute+memory form (reference roaring.go:1906-1949
+    computes on runs): a contiguous bulk import runifies in memory, ops
+    answer from intervals, and a fully-set container costs bytes, not 8 KiB."""
+    from pilosa_tpu.storage.bitmap import Container, _as_container
+
+    b = Bitmap()
+    b.add_many(np.arange(0, 1 << 16, dtype=np.uint64))  # full container
+    c = _as_container(b.containers[0])
+    assert c.runs is not None and len(c.runs) == 1
+    assert c.runs.nbytes == 4  # vs 8192 for the bitset form
+    assert c.n == 1 << 16
+    assert b.count() == 1 << 16
+    assert b.contains(12345) and not b.contains(1 << 16)
+    assert b.count_range(100, 300) == 200
+    # Point mutation flattens, bulk op re-runifies.
+    b.remove(500)
+    c = _as_container(b.containers[0])
+    assert c.runs is None and c.n == (1 << 16) - 1
+    b.add_many(np.array([500], dtype=np.uint64))
+    c = _as_container(b.containers[0])
+    assert c.runs is not None and c.n == 1 << 16
+
+
+def test_run_intersection_count_all_form_pairs():
+    """intersection_count must agree across all 3x3 form combinations."""
+    from pilosa_tpu.storage.bitmap import Container
+
+    rng = np.random.default_rng(77)
+
+    def forms(values):
+        arr = np.array(sorted(values), dtype=np.uint16)
+        a = Container(arr=arr.copy())
+        bts = Container(bits=a.as_words().copy())
+        r = Container(arr=arr.copy())
+        r._maybe_runify()
+        if r.runs is None:  # force the run form regardless of heuristics
+            from pilosa_tpu.storage.bitmap import _runs_of_array
+
+            r = Container(runs=_runs_of_array(arr))
+        return [a, bts, r]
+
+    va = set(range(100, 1000)) | set(rng.integers(0, 1 << 16, 500).tolist())
+    vb = set(range(500, 1500)) | set(rng.integers(0, 1 << 16, 500).tolist())
+    want = len(va & vb)
+    for ca in forms(va):
+        for cb in forms(vb):
+            assert ca.intersection_count(cb) == want, (ca, cb)
+
+
+def test_run_container_survives_roundtrip_as_runs():
+    b = Bitmap()
+    b.add_many(np.arange(1000, 60000, dtype=np.uint64))
+    data = b.to_bytes()
+    for copy in (True, False):
+        rt = Bitmap.from_buffer(data, copy=copy)
+        from pilosa_tpu.storage.bitmap import _as_container
+
+        c = _as_container(rt.containers[0])
+        assert c.runs is not None, f"copy={copy}"
+        assert rt.count() == 59000
+        assert rt == b
+
+
+def test_adversarial_contiguous_import_memory_bounded():
+    """1B-bit-scale contiguous range scaled down: every full container must
+    hold runs (≈4 B), not bitsets (8 KiB) — the host-memory blowup the
+    run form exists to prevent."""
+    from pilosa_tpu.storage.bitmap import _as_container
+
+    b = Bitmap()
+    n_containers = 64
+    b.add_many(np.arange(0, n_containers << 16, dtype=np.uint64))
+    payload = sum(
+        _as_container(c).runs.nbytes
+        for c in b.containers.values()
+        if _as_container(c).runs is not None
+    )
+    runified = sum(
+        1 for c in b.containers.values() if _as_container(c).runs is not None
+    )
+    assert runified == n_containers
+    assert payload == 4 * n_containers  # one [start,last] pair each
+    assert b.count() == n_containers << 16
+
+
+def test_run_form_ops_parity_with_oracle():
+    """Union/intersect/difference/xor and range reads on run containers
+    match the value-set oracle."""
+    from pilosa_tpu.storage.bitmap import Container, _runs_of_array
+
+    va = set(range(0, 30000)) | {40000, 40002, 50000}
+    vb = set(range(20000, 35000)) | {40002, 60001}
+    ca = Container(runs=_runs_of_array(np.array(sorted(va), dtype=np.uint16)))
+    cb = Container(runs=_runs_of_array(np.array(sorted(vb), dtype=np.uint16)))
+    assert set(ca.union(cb).to_array().tolist()) == va | vb
+    assert set(ca.intersect(cb).to_array().tolist()) == va & vb
+    assert set(ca.difference(cb).to_array().tolist()) == va - vb
+    assert set(ca.xor(cb).to_array().tolist()) == va ^ vb
+    assert ca.count_range(100, 25000) == len([v for v in va if 100 <= v < 25000])
+    assert list(ca.slice_range(29990, 40003)) == (
+        [v for v in sorted(va) if 29990 <= v < 40003]
+    )
+    assert ca.check("k") == []
+
+
+def test_fragment_snapshot_optimizes_to_runs(tmp_path):
+    """Point-mutation churn leaves flat forms; snapshot() re-compresses
+    (reference Optimize at snapshot)."""
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.storage.bitmap import _as_container
+
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+    f.open()
+    f.bulk_import(np.zeros(60000, dtype=np.uint64),
+                  np.arange(60000, dtype=np.uint64))
+    f.clear_bit(0, 123)  # flattens the run container
+    f.snapshot()
+    c = _as_container(f.storage.containers[0])
+    assert c.runs is not None and c.n == 59999
+    f.close()
+    # And it reopens correctly from the run-encoded file.
+    f2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+    f2.open()
+    assert f2.row_count(0) == 59999
+    f2.close()
+
+
+def test_corrupt_run_intervals_rejected():
+    """A hostile/corrupt run container (inverted or overlapping intervals)
+    must fail at parse time, not silently poison count/membership math."""
+    import struct
+
+    from pilosa_tpu.storage.bitmap import HEADER_BASE_SIZE
+
+    b = Bitmap()
+    b.add_many(np.arange(100, 50000, dtype=np.uint64))  # run-encoded
+    data = bytearray(b.to_bytes())
+    run_off = HEADER_BASE_SIZE + 12 + 4  # one container: header + offset
+    run_n, s0, l0 = struct.unpack_from("<HHH", data, run_off)
+    assert run_n == 1 and s0 == 100
+    struct.pack_into("<HH", data, run_off + 2, 50000, 100)  # inverted
+    with pytest.raises(ValueError, match="corrupt run"):
+        Bitmap.from_bytes(bytes(data))
+    with pytest.raises(ValueError, match="corrupt run"):
+        Bitmap.from_buffer(bytes(data), copy=False)
