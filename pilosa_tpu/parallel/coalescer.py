@@ -219,7 +219,7 @@ class QueryCoalescer:
             self._inflight.acquire()  # released by _finish
             try:
                 if len(items) == 1:
-                    _, call, _, fut, comp_expr = items[0]
+                    _, call, _, fut, comp_expr, _token = items[0]
                     out = self.engine.count_async(
                         index, call, shards, comp_expr=comp_expr
                     )

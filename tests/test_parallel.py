@@ -264,6 +264,31 @@ def test_query_coalescer_batches_concurrent_counts(holder, ex):
     assert co.batches_executed >= 1 and co.queries_batched >= 2
 
 
+def test_coalescer_single_query_window(holder, ex):
+    """A window that catches exactly ONE query takes the single-dispatch
+    branch (no batch) and must still answer correctly — regression for the
+    6-tuple unpack crash that 500'd lone-window queries."""
+    from pilosa_tpu.parallel.coalescer import QueryCoalescer
+
+    plant(holder, ex)
+    engine = ShardedQueryEngine(holder)
+    co = QueryCoalescer(engine, window=0.001, force=True)
+    shards = list(range(5))
+    call = parse("Intersect(Row(f=1), Row(g=3))").calls[0]
+    try:
+        got = co.count("i", call, shards)  # lone query -> group of 1
+        assert co.batches_executed == 0  # single-dispatch branch taken
+        # The memo was fed by the FINISHER: probe it directly before
+        # anything else could repopulate it (engine.count would memo_store
+        # on a miss and make this assertion vacuous).
+        comp, _ = engine._compile("i", call)
+        hit, _ = engine.memo_probe("i", comp, tuple(shards))
+        assert hit == got
+        assert got == engine.count("i", call, shards)
+    finally:
+        co.close()
+
+
 def test_coalescer_adaptive_regimes():
     """The round-3 regression fix: batching is bypassed on a remote-runtime
     link (blocking clients already pipeline N RTTs) and on idle traffic,

