@@ -242,11 +242,13 @@ class QueryCoalescer:
         try:
             counts = np.asarray(out).reshape(-1)
             for it, n in zip(items, counts[: len(items)]):
-                it[3].set_result(int(n))
-                # Feed the result memo with the PROBE-TIME token so a write
-                # that landed mid-flight invalidates rather than getting a
-                # stale count stamped with its own generation.
+                # Feed the result memo BEFORE resolving the future, with
+                # the PROBE-TIME token so a write that landed mid-flight
+                # invalidates rather than getting a stale count stamped
+                # with its own generation. Store-then-resolve means a
+                # caller that observes the result also observes the memo.
                 self.engine.memo_store(it[5], int(n))
+                it[3].set_result(int(n))
         except Exception as e:
             for it in items:
                 if not it[3].done():

@@ -251,6 +251,12 @@ class ShardedQueryEngine:
         # the same per-fragment generation counters as the leaf cache.
         self._memo: Dict[Tuple, Tuple[Tuple, int]] = {}
         self._memo_budget = int(os.environ.get("PILOSA_MEMO_ENTRIES", 8192))
+        # Composite-result memo (TopN per-shard matrices, BSI val counts):
+        # a repeat TopN pays zero device round trips — phase-1 AND the
+        # phase-2 refetch hit here. Bounded by entries (values are small
+        # (R,S) host arrays); shares the memo hit/miss counters.
+        self._aux_memo: Dict[Tuple, Tuple[Tuple, object]] = {}
+        self._aux_budget = int(os.environ.get("PILOSA_AUX_MEMO_ENTRIES", 512))
         # Observable cache behavior (hit rate / eviction pressure) for
         # /debug/vars and the HBM-budget bench stanza.
         self.counters = {
@@ -484,6 +490,26 @@ class ShardedQueryEngine:
             self._memo[key] = (fp, count)
             while len(self._memo) > self._memo_budget:
                 self._memo.pop(next(iter(self._memo)))
+
+    def _aux_probe(self, key, fp):
+        """Generation-checked memo for composite results (TopN count
+        matrices, BSI val-count outputs). Same probe-time-fingerprint
+        discipline as memo_probe; values are small host arrays."""
+        with self._lock:
+            ent = self._aux_memo.get(key)
+            if ent is not None and ent[0] == fp:
+                self._aux_memo[key] = self._aux_memo.pop(key)  # LRU touch
+                self.counters["memo_hits"] += 1
+                return ent[1]
+            self.counters["memo_misses"] += 1
+        return None
+
+    def _aux_store(self, key, fp, value) -> None:
+        with self._lock:
+            self._aux_memo.pop(key, None)
+            self._aux_memo[key] = (fp, value)
+            while len(self._aux_memo) > self._aux_budget:
+                self._aux_memo.pop(next(iter(self._aux_memo)))
 
     # -------------------------------------------------------------- queries
 
@@ -747,13 +773,45 @@ class ShardedQueryEngine:
         popcounts.
         """
         shards = tuple(shards)
-        leaves = [Leaf(field, VIEW_STANDARD, r) for r in row_ids]
-        rows_tensor = self._stacked_leaf_tensor(index, leaves, shards)  # (R, S, W)
+        # Canonical (sorted, deduped) row order: the stacked tensor and the
+        # result memo are keyed on it, so TopN phase-1 (first-seen candidate
+        # order) and the phase-2 refetch (sorted ids) share one device
+        # tensor and one memo entry instead of duplicating both.
+        req = np.asarray(row_ids, dtype=np.int64)
+        canon = np.unique(req)
+        sel = np.searchsorted(canon, req)  # canonical -> requested order
+        canon_rows = [int(r) for r in canon]
         s_real = len(shards)
+        leaves = [Leaf(field, VIEW_STANDARD, r) for r in canon_rows]
+        src_sig = None
+        comp = expr = None
         if src_call is not None:
             comp, expr = self._compile(index, src_call)
+            src_sig = tuple(comp.signature)
+        mkey = ("topn_shard", index, field, tuple(canon_rows), shards,
+                src_sig, tuple(comp.leaves) if comp else None)
+        fp = tuple(self._fingerprint(index, leaf, shards) for leaf in leaves)
+        if comp is not None:
+            fp = fp + tuple(
+                self._fingerprint(index, leaf, shards) for leaf in comp.leaves
+            )
+
+        def answer(value):
+            row_counts, inter, src_counts = value
+            return (
+                row_counts[sel],
+                inter[sel] if inter is not None else None,
+                src_counts,
+            )
+
+        hit = self._aux_probe(mkey, fp)
+        if hit is not None:
+            return answer(hit)
+
+        rows_tensor = self._stacked_leaf_tensor(index, leaves, shards)  # (R, S, W)
+        if src_call is not None:
             src_leaves = self._leaf_tensor(index, comp.leaves, shards)
-            sig = ("topn_shard_src", tuple(comp.signature), len(shards), len(row_ids))
+            sig = ("topn_shard_src", src_sig, len(shards), len(canon_rows))
 
             def build():
                 @jax.jit
@@ -775,25 +833,27 @@ class ShardedQueryEngine:
 
             fn = self._fn_build(self._count_fns, sig, build)
             row_counts, inter, src_counts = fn(rows_tensor, src_leaves)
-            return (
+            value = (
                 np.asarray(row_counts)[:, :s_real],
                 np.asarray(inter)[:, :s_real],
                 np.asarray(src_counts)[:s_real],
             )
+        else:
+            sig = ("topn_shard", len(shards), len(canon_rows))
 
-        sig = ("topn_shard", len(shards), len(row_ids))
+            def build():
+                @jax.jit
+                def fn(stacked):
+                    return jnp.sum(
+                        jax.lax.population_count(stacked).astype(jnp.int32), axis=2
+                    )
 
-        def build():
-            @jax.jit
-            def fn(stacked):
-                return jnp.sum(
-                    jax.lax.population_count(stacked).astype(jnp.int32), axis=2
-                )
+                return fn
 
-            return fn
-
-        fn = self._fn_build(self._count_fns, sig, build)
-        return np.asarray(fn(rows_tensor))[:, :s_real], None, None
+            fn = self._fn_build(self._count_fns, sig, build)
+            value = (np.asarray(fn(rows_tensor))[:, :s_real], None, None)
+        self._aux_store(mkey, fp, value)
+        return answer(value)
 
     def topn_counts(
         self, index: str, field: str, row_ids: Sequence[int],
@@ -852,14 +912,28 @@ class ShardedQueryEngine:
         shards = tuple(shards)
         view = VIEW_BSI_GROUP_PREFIX + field
         leaves = [Leaf(field, view, i) for i in range(bit_depth + 1)]
-        planes = self._stacked_leaf_tensor(index, leaves, shards)  # (D+1, S, W)
-        filter_leaves = None
         fsig = ()
-        expr = None
+        comp = expr = None
         if filter_call is not None:
             comp, expr = self._compile(index, filter_call)
-            filter_leaves = self._leaf_tensor(index, comp.leaves, shards)
             fsig = tuple(comp.signature)
+        # Result memo: a repeat Sum/Min/Max over unchanged fragments is
+        # host-only work (the val-count outputs are tiny).
+        mkey = ("bsi", index, field, kind, bit_depth, shards, fsig,
+                tuple(comp.leaves) if comp else None)
+        fp = tuple(self._fingerprint(index, leaf, shards) for leaf in leaves)
+        if comp is not None:
+            fp = fp + tuple(
+                self._fingerprint(index, leaf, shards) for leaf in comp.leaves
+            )
+        hit = self._aux_probe(mkey, fp)
+        if hit is not None:
+            return hit
+
+        planes = self._stacked_leaf_tensor(index, leaves, shards)  # (D+1, S, W)
+        filter_leaves = None
+        if filter_call is not None:
+            filter_leaves = self._leaf_tensor(index, comp.leaves, shards)
         sig = ("bsi", kind, bit_depth, len(shards), fsig)
 
         def build():
@@ -904,9 +978,12 @@ class ShardedQueryEngine:
         fn = self._fn_build(self._count_fns, sig, build)
         out = fn(planes, filter_leaves)
         if kind == "sum":
-            return np.asarray(out)
-        bits, count = out
-        return np.asarray(bits), int(count)
+            value = np.asarray(out)
+        else:
+            bits, count = out
+            value = (np.asarray(bits), int(count))
+        self._aux_store(mkey, fp, value)
+        return value
 
     def supports(self, call: Call, index: Optional[str] = None):
         """Truthy if `call` compiles onto the fast path.

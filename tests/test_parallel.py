@@ -397,3 +397,46 @@ def test_executor_coalesce_window_wiring(holder, ex):
     assert ex2.execute("i", "Count(Intersect(Row(f=1), Row(g=3)))") == [want]
     assert ex2.coalescer is not None
     ex2.coalescer.close()
+
+
+def test_topn_shard_counts_memo_and_invalidation(holder, ex):
+    """Repeat TopN count-matrix requests are memo hits (any row order —
+    canonical keying), and a write to a member fragment invalidates."""
+    plant(holder, ex)
+    engine = ShardedQueryEngine(holder)
+    shards = list(range(5))
+    rows = [2, 1]
+    a1, _, _ = engine.topn_shard_counts("i", "f", rows, shards)
+    base = dict(engine.counters)
+    a2, _, _ = engine.topn_shard_counts("i", "f", [1, 2], shards)  # reordered
+    assert engine.counters["memo_hits"] == base["memo_hits"] + 1
+    import numpy as np
+
+    np.testing.assert_array_equal(a1[0], a2[1])  # row 2
+    np.testing.assert_array_equal(a1[1], a2[0])  # row 1
+    # A write to row 1's fragment invalidates the entry.
+    assert holder.fragment("i", "f", "standard", 0).set_bit(1, 5000)
+    a3, _, _ = engine.topn_shard_counts("i", "f", rows, shards)
+    assert int(a3[1].sum()) == int(a1[1].sum()) + 1
+    assert engine.counters["memo_misses"] > base["memo_misses"]
+
+
+def test_bsi_val_count_memo_and_invalidation(holder, ex):
+    from pilosa_tpu.core.field import FieldOptions
+
+    idx = holder.index("i") or holder.create_index("i")
+    idx.create_field_if_not_exists("v", FieldOptions(type="int", min=0, max=1000))
+    ex.execute("i", "SetValue(col=1, v=5)")
+    ex.execute("i", "SetValue(col=2, v=7)")
+    engine = ShardedQueryEngine(holder)
+    depth = idx.field("v").bsi_group("v").bit_depth()
+    counts1 = engine.bsi_val_count("i", "v", "sum", depth, [0])
+    base = dict(engine.counters)
+    counts2 = engine.bsi_val_count("i", "v", "sum", depth, [0])
+    assert engine.counters["memo_hits"] == base["memo_hits"] + 1
+    import numpy as np
+
+    np.testing.assert_array_equal(counts1, counts2)
+    ex.execute("i", "SetValue(col=3, v=9)")
+    counts3 = engine.bsi_val_count("i", "v", "sum", depth, [0])
+    assert int(counts3[depth]) == int(counts1[depth]) + 1
