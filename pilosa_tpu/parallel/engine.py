@@ -860,12 +860,33 @@ class ShardedQueryEngine:
         shards: Sequence[int], src_call: Optional[Call] = None,
     ) -> np.ndarray:
         """Total per-row counts across shards (optionally ∩ src bitmap) in
-        one batched program — the distributed TopN inner loop."""
+        one batched program — the distributed TopN inner loop. Canonical
+        row ordering + the composite-result memo, as topn_shard_counts."""
         shards = tuple(shards)
-        leaves = [Leaf(field, VIEW_STANDARD, r) for r in row_ids]
+        req = np.asarray(row_ids, dtype=np.int64)
+        canon = np.unique(req)
+        sel = np.searchsorted(canon, req)
+        row_ids = [int(r) for r in canon]
+        src_sig = None
+        comp0 = expr0 = None
+        if src_call is not None:
+            comp0, expr0 = self._compile(index, src_call)
+            src_sig = tuple(comp0.signature)
+        mkey = ("topn_total", index, field, tuple(row_ids), shards, src_sig,
+                tuple(comp0.leaves) if comp0 else None)
+        leaves_fp = [Leaf(field, VIEW_STANDARD, r) for r in row_ids]
+        fp = tuple(self._fingerprint(index, leaf, shards) for leaf in leaves_fp)
+        if comp0 is not None:
+            fp = fp + tuple(
+                self._fingerprint(index, leaf, shards) for leaf in comp0.leaves
+            )
+        hit = self._aux_probe(mkey, fp)
+        if hit is not None:
+            return hit[sel]
+        leaves = leaves_fp
         rows_tensor = self._stacked_leaf_tensor(index, leaves, shards)  # (R, S, W)
         if src_call is not None:
-            comp, expr = self._compile(index, src_call)
+            comp, expr = comp0, expr0  # compiled once above for the memo key
             src_leaves = self._leaf_tensor(index, comp.leaves, shards)
             sig = ("topn_src", tuple(comp.signature), len(shards), len(row_ids))
 
@@ -881,7 +902,9 @@ class ShardedQueryEngine:
                 return fn
 
             fn = self._fn_build(self._count_fns, sig, build)
-            return np.asarray(fn(rows_tensor, src_leaves))
+            value = np.asarray(fn(rows_tensor, src_leaves))
+            self._aux_store(mkey, fp, value)
+            return value[sel]
 
         sig = ("topn", len(shards), len(row_ids))
 
@@ -895,7 +918,9 @@ class ShardedQueryEngine:
             return fn
 
         fn = self._fn_build(self._count_fns, sig, build)
-        return np.asarray(fn(rows_tensor))
+        value = np.asarray(fn(rows_tensor))
+        self._aux_store(mkey, fp, value)
+        return value[sel]
 
     def bsi_val_count(
         self, index: str, field: str, kind: str, bit_depth: int,
