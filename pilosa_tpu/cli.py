@@ -170,7 +170,7 @@ def cmd_export(args) -> int:
 
 
 def cmd_inspect(args) -> int:
-    from .storage.bitmap import Bitmap
+    from .storage.bitmap import Bitmap, _as_container
 
     for path in args.paths:
         with open(path, "rb") as f:
@@ -180,17 +180,20 @@ def cmd_inspect(args) -> int:
         except ValueError as e:
             print(f"{path}: INVALID ({e})")
             continue
-        n_array = n_bitmap_like = 0
+        forms = {"array": 0, "dense": 0, "run": 0}
+        lines = []
         for key, c in sorted(bm.containers.items()):
-            if len(c) <= 4096:
-                n_array += 1
-            else:
-                n_bitmap_like += 1
+            cc = _as_container(c)
+            form = ("run" if cc.runs is not None
+                    else "dense" if cc.bits is not None else "array")
+            forms[form] += 1
+            if args.containers:
+                lines.append(f"  key={key} n={len(cc)} form={form}")
         print(f"{path}: containers={len(bm.containers)} bits={bm.count()} "
-              f"ops={bm.op_n} array={n_array} dense={n_bitmap_like}")
-        if args.containers:
-            for key, c in sorted(bm.containers.items()):
-                print(f"  key={key} n={len(c)}")
+              f"ops={bm.op_n} array={forms['array']} dense={forms['dense']} "
+              f"run={forms['run']}")
+        for line in lines:
+            print(line)
     return 0
 
 

@@ -457,3 +457,69 @@ def test_corrupt_run_intervals_rejected():
         Bitmap.from_bytes(bytes(data))
     with pytest.raises(ValueError, match="corrupt run"):
         Bitmap.from_buffer(bytes(data), copy=False)
+
+
+def test_container_forms_fuzz_against_set_oracle():
+    """Randomized op sequences over all three container forms vs a python
+    set oracle: point ops, bulk ops, algebra, range reads, serialization
+    round trips. Catches form-transition edge cases (runify/flatten/
+    densify/sparsify interactions) that targeted tests miss."""
+    rng = np.random.default_rng(2024)
+
+    for trial in range(6):
+        oracle = set()
+        b = Bitmap()
+        for step in range(12):
+            op = rng.integers(0, 6)
+            if op == 0:  # point adds
+                vals = rng.integers(0, 1 << 18, rng.integers(1, 50))
+                for v in vals:
+                    b.add(int(v))
+                    oracle.add(int(v))
+            elif op == 1:  # point removes
+                if oracle:
+                    pool = rng.choice(list(oracle), min(len(oracle), 30))
+                    for v in pool:
+                        b.remove(int(v))
+                        oracle.discard(int(v))
+            elif op == 2:  # bulk contiguous add (exercises runify)
+                start = int(rng.integers(0, 1 << 17))
+                width = int(rng.integers(100, 80000))
+                vals = np.arange(start, start + width, dtype=np.uint64)
+                b.add_many(vals)
+                oracle.update(range(start, start + width))
+            elif op == 3:  # bulk random add (exercises densify)
+                vals = np.unique(rng.integers(0, 1 << 18, 5000)).astype(np.uint64)
+                b.add_many(vals)
+                oracle.update(int(v) for v in vals)
+            elif op == 4:  # bulk remove
+                if oracle:
+                    pool = np.unique(
+                        rng.choice(list(oracle), min(len(oracle), 4000))
+                    ).astype(np.uint64)
+                    b.remove_many(pool)
+                    oracle.difference_update(int(v) for v in pool)
+            else:  # serialization round trip (both eager and lazy)
+                data = b.to_bytes()
+                b = Bitmap.from_buffer(
+                    data, copy=bool(rng.integers(0, 2))
+                )
+            assert b.count() == len(oracle), (trial, step)
+            assert b.check() == [], (trial, step, b.check())
+
+        # Final algebra vs oracle against a second random bitmap.
+        other_vals = np.unique(np.concatenate([
+            rng.integers(0, 1 << 18, 3000),
+            np.arange(5000, 45000),  # run-heavy region
+        ])).astype(np.uint64)
+        other = Bitmap(other_vals)
+        other.optimize()
+        oset = set(int(v) for v in other_vals)
+        assert set(int(v) for v in b.union(other).slice()) == oracle | oset
+        assert set(int(v) for v in b.intersect(other).slice()) == oracle & oset
+        assert set(int(v) for v in b.difference(other).slice()) == oracle - oset
+        assert set(int(v) for v in b.xor(other).slice()) == oracle ^ oset
+        assert b.intersection_count(other) == len(oracle & oset)
+        # Range reads on the final state.
+        lo, hi = 3000, 120000
+        assert b.count_range(lo, hi) == len([v for v in oracle if lo <= v < hi])
