@@ -211,13 +211,27 @@ def bench_device(ex, n_rows, n_shards, iters):
 
     engine = ex.engine
     shards = list(range(n_shards))
+    pairs = _distinct_pairs(n_rows, iters)
     calls = [
         parse(f"Count(Intersect(Row(f={a}), Row(f={b})))").calls[0].children[0]
-        for a, b in _distinct_pairs(n_rows, iters)
+        for a, b in pairs
     ]
     # Warmup: compile the batch program + populate the device leaf cache.
-    engine.count_batch("bench", calls, shards)
+    warm = engine.count_batch("bench", calls, shards)
     ex.execute("bench", "TopN(f, n=5)")
+
+    # Correctness guard on the exact path being timed (on TPU this is the
+    # Pallas gather kernel): spot-check batched counts against host math.
+    rng_chk = np.random.default_rng(7)
+    for qi in rng_chk.choice(len(calls), size=min(4, len(calls)), replace=False):
+        a, b = pairs[qi]
+        want = 0
+        for s in range(n_shards):
+            frag = ex.holder.fragment("bench", "f", "standard", s)
+            want += int(np.bitwise_count(np.bitwise_and(
+                frag.plane_np(a), frag.plane_np(b))).sum())
+        assert int(warm[qi]) == want, (
+            f"device batch count mismatch q{qi}: {int(warm[qi])} != {want}")
 
     # Pipelined serving: keep several batches in flight so device compute
     # and host<->device transfer overlap (a serving loop with concurrent

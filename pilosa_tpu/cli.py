@@ -183,6 +183,9 @@ def cmd_inspect(args) -> int:
         forms = {"array": 0, "dense": 0, "run": 0}
         lines = []
         for key, c in sorted(bm.containers.items()):
+            # _as_container is a no-op for plain from_bytes output today,
+            # but keeps inspect correct if a container-factory tier (the
+            # btree store swap) ever hands back non-Container payloads.
             cc = _as_container(c)
             form = ("run" if cc.runs is not None
                     else "dense" if cc.bits is not None else "array")
