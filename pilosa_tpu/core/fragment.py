@@ -397,6 +397,18 @@ class Fragment:
         out = sort_pairs([Pair(id=r, count=c) for c, r in results])
         return out
 
+    @staticmethod
+    def row_attrs_match(store, row_id: int, name: str, values) -> bool:
+        """THE attr-filter predicate (reference fragment.go:922-934) —
+        one implementation shared by the per-fragment candidate filter and
+        the executor's batched TopN paths so they cannot silently
+        diverge: rows with no attrs, or whose `name` attr is not in
+        `values`, are filtered out."""
+        attrs = store.attrs(row_id) if store else None
+        if not attrs:
+            return False
+        return attrs.get(name) in values
+
     def _filter_candidates(self, pairs, opt: TopOptions, min_tan: float,
                            max_tan: float, filters) -> List[Tuple[int, int]]:
         candidates: List[Tuple[int, int]] = []  # (row_id, cnt)
@@ -421,12 +433,9 @@ class Fragment:
             elif cnt < opt.min_threshold:
                 continue
             if filters is not None:
-                attrs = (
-                    self.row_attr_store.attrs(row_id) if self.row_attr_store else None
-                )
-                if not attrs:
-                    continue
-                if attrs.get(opt.filter_name) not in filters:
+                if not self.row_attrs_match(
+                    self.row_attr_store, row_id, opt.filter_name, filters
+                ):
                     continue
             candidates.append((row_id, cnt))
         return candidates

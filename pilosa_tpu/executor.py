@@ -706,26 +706,41 @@ class Executor:
                 self._collective_fallback(e)
         if (
             ids
-            and not c.args.get("attrName")
             and src_call is not None  # without src the host rank cache has
             and self.engine.supports(src_call, index)  # exact counts; device adds RTT
         ):
             # Batched phase-2: all candidate counts across all local shards
-            # in one device program, preserving per-shard MinThreshold and
-            # tanimoto semantics (fragment.go:899-990, 1008-1027 — the
-            # coefficient is a pure function of the (row, inter, src)
-            # counts the program already produces).
+            # in one device program, preserving per-shard MinThreshold,
+            # tanimoto (fragment.go:899-990, 1008-1027 — the coefficient is
+            # a pure function of the (row, inter, src) counts the program
+            # already produces), and attr-filter semantics (a host-side
+            # per-row check against the field's row attr store,
+            # fragment.go:922-934 — filtered rows never join the program).
             field_name = c.args.get("_field") or DEFAULT_FIELD
             thr = max(c.uint_arg("threshold")[0], DEFAULT_MIN_THRESHOLD)
+            attr_name = c.args.get("attrName", "")
+            attr_values = set(c.args.get("attrValues") or [])
 
             def local_runner(local_shards):
                 import math
 
+                run_ids = ids
+                if attr_name and attr_values:
+                    from .core.fragment import Fragment
+
+                    fld = self.holder.field(index, field_name)
+                    store = fld.row_attr_store if fld else None
+                    run_ids = [
+                        r for r in ids
+                        if Fragment.row_attrs_match(store, r, attr_name, attr_values)
+                    ]
+                    if not run_ids:
+                        return []
                 row_counts, inter, src_counts = self.engine.topn_shard_counts(
-                    index, field_name, ids, local_shards, src_call
+                    index, field_name, run_ids, local_shards, src_call
                 )
                 pairs: Dict[int, int] = {}
-                for ri, row_id in enumerate(ids):
+                for ri, row_id in enumerate(run_ids):
                     for si in range(len(local_shards)):
                         cnt = int(row_counts[ri, si])
                         if cnt <= 0:

@@ -230,6 +230,15 @@ def test_topn_attr_filter_with_src_batched_matches_fallback(holder, ex):
     assert got == want and got, (got, want)
     assert all(r % 2 == 0 for r, _ in got)
 
+    # Explicit ids + attr filter: the batched phase-2 path prefilters rows
+    # against the attr store before they join the device program.
+    q2 = ('TopN(f, Row(g=3), ids=[0,1,2,3,4,5], '
+          'attrName="category", attrValues=["even"])')
+    got2 = [(p.id, p.count) for p in ex.execute("i", q2)[0]]
+    want2 = _force_fallback_topn(ex, q2)
+    assert got2 == want2 and got2, (got2, want2)
+    assert {r for r, _ in got2} <= {0, 2, 4}
+
 
 def test_topn_tanimoto_over_100_rejected(holder, ex):
     setup_index(holder)
