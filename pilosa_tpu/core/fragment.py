@@ -36,7 +36,7 @@ from ..constants import (
 )
 from ..errors import ColumnRowOutOfRangeError
 from ..ops import bitplane as bp
-from ..storage.bitmap import OP_ADD, OP_REMOVE, Bitmap, encode_op
+from ..storage.bitmap import OP_ADD, OP_REMOVE, Bitmap, _as_container, encode_op
 from .cache import NopCache, Pair, new_cache, sort_pairs
 from .row import Row
 
@@ -46,14 +46,19 @@ import hashlib
 TOPN_BATCH = 256
 
 
-def _block_hash(positions: np.ndarray) -> bytes:
-    """Checksum of sorted bit positions within a merkle block.
+def _block_hasher():
+    """THE merkle block digest (one definition for the streaming blocks()
+    path and the _block_hash oracle, so they cannot silently diverge).
 
     The reference uses xxhash over (row, col) pairs (fragment.go:1078-1174);
     we use blake2b-8 — checksums only ever compare against this framework's
-    own, so cross-implementation byte parity is not required.
-    """
-    h = hashlib.blake2b(digest_size=8)
+    own, so cross-implementation byte parity is not required."""
+    return hashlib.blake2b(digest_size=8)
+
+
+def _block_hash(positions: np.ndarray) -> bytes:
+    """Checksum of sorted bit positions within a merkle block."""
+    h = _block_hasher()
     h.update(positions.astype("<u8").tobytes())
     return h.digest()
 
@@ -466,11 +471,52 @@ class Fragment:
     # --------------------------------------------------------------- blocks
 
     def blocks(self) -> List[FragmentBlock]:
-        """Merkle block checksums of HASH_BLOCK_SIZE-row groups."""
+        """Merkle block checksums of HASH_BLOCK_SIZE-row groups.
+
+        Streams one container at a time instead of materializing every set
+        position at once (storage.slice() costs 8 bytes PER BIT — on an
+        RLE-heavy fragment that would undo the run form's memory bound on
+        every anti-entropy sweep). Containers never straddle blocks:
+        HASH_BLOCK_SIZE*SHARD_WIDTH is an exact multiple of 2^16, so each
+        block's digest is the ascending concatenation of its containers'
+        global positions — byte-identical to the all-at-once hash."""
+        block_width = HASH_BLOCK_SIZE * SHARD_WIDTH
+        if block_width % (1 << 16):
+            # Non-default PILOSA_TPU_SHARD_WIDTH_EXP can make containers
+            # straddle block boundaries; fall back to the all-at-once hash
+            # (correct for any width, at slice() memory cost).
+            return self._blocks_via_slice(block_width)
+        containers_per_block = block_width >> 16
+        out = []
+        by_block: Dict[int, List[int]] = {}
+        for key in sorted(self.storage.containers):
+            by_block.setdefault(int(key) // containers_per_block, []).append(int(key))
+        for bid in sorted(by_block):
+            cached = self._checksums.get(bid)
+            if cached is None:
+                h = _block_hasher()
+                any_bits = False
+                for key in by_block[bid]:
+                    c = _as_container(self.storage.containers[key])
+                    vals = c.to_array()
+                    if not len(vals):
+                        continue
+                    any_bits = True
+                    positions = (np.uint64(key) << np.uint64(16)) | vals.astype(
+                        np.uint64
+                    )
+                    h.update(positions.astype("<u8").tobytes())
+                if not any_bits:
+                    continue  # all-empty containers: no block (as before)
+                cached = h.digest()
+                self._checksums[bid] = cached
+            out.append(FragmentBlock(id=bid, checksum=cached))
+        return out
+
+    def _blocks_via_slice(self, block_width: int) -> List[FragmentBlock]:
         vals = self.storage.slice()
         if len(vals) == 0:
             return []
-        block_width = HASH_BLOCK_SIZE * SHARD_WIDTH
         block_ids = (vals // np.uint64(block_width)).astype(np.int64)
         out = []
         for bid in np.unique(block_ids):
