@@ -379,6 +379,7 @@ def bench_hbm():
     gib = float(os.environ.get("BENCH_HBM_GIB", default_gib))
     s, w = 8, WORDS_PER_ROW
     u = max(16, int(gib * 2**30 / (s * w * 4)))
+    u = -(-u // 8) * 8  # multiple of 8: the stack builds in 8 donated chunks
     q = min(1024, u)
     r = 16
     out = {"stack_gib": round(u * s * w * 4 / 2**30, 3),
@@ -387,7 +388,21 @@ def bench_hbm():
     key = jax.random.PRNGKey(0)
     k1, k2, k3 = jax.random.split(key, 3)
     t0 = time.perf_counter()
-    stacked = jax.random.bits(k1, (u, s, w), dtype=jnp.uint32)
+    # Chunked fill with buffer donation: one jax.random.bits call for the
+    # whole stack peaks at ~2x its size (PRNG counter buffers), which OOMs
+    # a 16 GiB chip at the 8 GiB default. Donating the accumulator keeps
+    # peak at stack + one chunk.
+    n_chunks = 8
+    cu = u // n_chunks
+
+    def fill(buf, ck, i):
+        chunk = jax.random.bits(ck, (cu, s, w), dtype=jnp.uint32)
+        return jax.lax.dynamic_update_slice(buf, chunk, (i * cu, 0, 0))
+
+    fill = jax.jit(fill, donate_argnums=(0,))
+    stacked = jnp.zeros((u, s, w), dtype=jnp.uint32)
+    for i, ck in enumerate(jax.random.split(k1, n_chunks)):
+        stacked = fill(stacked, ck, jnp.int32(i))
     stacked.block_until_ready()
     out["build_s"] = round(time.perf_counter() - t0, 1)
     ia = jax.random.randint(k2, (r, q), 0, u, dtype=jnp.int32)
