@@ -20,52 +20,6 @@ def rand_plane(n=32768):
     return RNG.integers(0, 1 << 32, n, dtype=np.uint32)
 
 
-def test_fused_intersection_count():
-    a, b = rand_plane(), rand_plane()
-    assert int(pk.fused_intersection_count(a, b)) == np_popcount(a & b)
-
-
-def test_fused_intersection_count_nonaligned():
-    # Width not a multiple of the VMEM block: padding must not change counts.
-    a, b = rand_plane(1000), rand_plane(1000)
-    assert int(pk.fused_intersection_count(a, b)) == np_popcount(a & b)
-
-
-def test_fused_nary_count_tree():
-    a, b, c = rand_plane(4096), rand_plane(4096), rand_plane(4096)
-    # (a & b) | (c &~ a)
-    tape = (
-        (pk.OP_AND, 0, 1),      # slot 3 = a & b
-        (pk.OP_ANDNOT, 2, 0),   # slot 4 = c &~ a
-        (pk.OP_OR, 3, 4),       # slot 5
-    )
-    got = int(pk.fused_nary_count(tape, a, b, c))
-    want = np_popcount((a & b) | (c & ~a))
-    assert got == want
-
-
-def test_fused_nary_count_xor():
-    a, b = rand_plane(4096), rand_plane(4096)
-    got = int(pk.fused_nary_count(((pk.OP_XOR, 0, 1),), a, b))
-    assert got == np_popcount(a ^ b)
-
-
-def test_topn_filter_counts():
-    rows = np.stack([rand_plane(16384) for _ in range(6)])
-    filt = rand_plane(16384)
-    got = np.asarray(pk.topn_filter_counts(rows, filt))
-    want = [np_popcount(r & filt) for r in rows]
-    assert got.tolist() == want
-
-
-def test_topn_filter_counts_multiblock():
-    rows = np.stack([rand_plane(pk.BLOCK * 2) for _ in range(3)])
-    filt = rand_plane(pk.BLOCK * 2)
-    got = np.asarray(pk.topn_filter_counts(rows, filt))
-    want = [np_popcount(r & filt) for r in rows]
-    assert got.tolist() == want
-
-
 def test_batched_gather_expr_count():
     # (U, S, W) stack; queries gather leaf pairs and count the intersection.
     import jax.numpy as jnp
@@ -108,4 +62,23 @@ def test_batched_gather_expr_count_three_leaves():
         )
         for i in range(q)
     ])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_batched_gather_expr_count_w_chunked(monkeypatch):
+    """When the leaf blocks exceed the VMEM budget the W axis chunks
+    (grid (Q, n_wb) with accumulated partials) — results must not change."""
+    import jax.numpy as jnp
+
+    monkeypatch.setattr(pk, "_GATHER_VMEM_BUDGET", 2 * 2 * 4 * 256 * 4 // 2)
+    u, s, w, q = 6, 4, 1024, 5  # forces wc < w under the tiny budget
+    stacked = RNG.integers(0, 1 << 32, (u, s, w), dtype=np.uint32)
+    ia = RNG.integers(0, u, q).astype(np.int32)
+    ib = RNG.integers(0, u, q).astype(np.int32)
+
+    def expr(planes):
+        return jnp.bitwise_and(planes[0], planes[1])
+
+    got = np.asarray(pk.batched_gather_expr_count(jnp.asarray(stacked), (ia, ib), expr))
+    want = np.array([np_popcount(stacked[ia[i]] & stacked[ib[i]]) for i in range(q)])
     np.testing.assert_array_equal(got, want)
