@@ -8,7 +8,7 @@ the executor can use placement without pulling in networking.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional
 
 from ..constants import DEFAULT_PARTITION_N

@@ -10,7 +10,7 @@ from __future__ import annotations
 import json
 import os
 import threading
-from dataclasses import asdict, dataclass, field as dc_field
+from dataclasses import dataclass
 from datetime import datetime
 from typing import Dict, List, Optional, Tuple
 
@@ -33,7 +33,7 @@ from ..errors import (
     PilosaError,
     validate_name,
 )
-from ..pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
+from ..pql.ast import EQ, GT, GTE, LT, LTE, NEQ
 from ..timeq import parse_time_quantum, views_by_time
 from .attrs import AttrStore, MemAttrStore
 from .row import Row

@@ -20,7 +20,7 @@ from __future__ import annotations
 import heapq
 import os
 import struct
-from dataclasses import dataclass, field as dataclass_field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp

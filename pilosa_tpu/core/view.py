@@ -12,13 +12,7 @@ import os
 import threading
 from typing import Callable, Dict, List, Optional
 
-from ..constants import (
-    CACHE_TYPE_RANKED,
-    DEFAULT_CACHE_SIZE,
-    SHARD_WIDTH,
-    VIEW_BSI_GROUP_PREFIX,
-    VIEW_STANDARD,
-)
+from ..constants import CACHE_TYPE_RANKED, DEFAULT_CACHE_SIZE, SHARD_WIDTH
 from .fragment import Fragment
 
 

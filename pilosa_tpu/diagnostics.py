@@ -9,7 +9,6 @@ reference's hourly phone-home to diagnostics.pilosa.com becomes opt-in.
 from __future__ import annotations
 
 import json
-import threading
 import time
 import urllib.request
 from typing import Dict, Optional

@@ -11,8 +11,7 @@ from __future__ import annotations
 
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass, field as dc_field
-from datetime import datetime
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
 
 from .constants import MAX_WRITES_PER_REQUEST, SHARD_WIDTH, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
@@ -29,7 +28,7 @@ from .errors import (
     TooManyWritesError,
 )
 from .pql import parser as pql_parser
-from .pql.ast import BETWEEN, Call, Condition, GT, GTE, LT, LTE, NEQ, Query
+from .pql.ast import BETWEEN, Call, Condition, GT, GTE, LT, LTE, NEQ
 from .timeq import parse_timestamp, views_by_time_range
 
 DEFAULT_FIELD = "general"

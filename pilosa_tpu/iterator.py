@@ -6,7 +6,7 @@ support — used by export, block-data extraction and tests.
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Tuple
+from typing import Iterator, Optional, Tuple
 
 import numpy as np
 

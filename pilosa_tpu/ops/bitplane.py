@@ -18,14 +18,12 @@ BSI algorithms are the bit-sliced routines of /root/reference/fragment.go:
 
 from __future__ import annotations
 
-import functools
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..constants import BITS_PER_WORD, SHARD_WIDTH, WORDS_PER_ROW
+from ..constants import BITS_PER_WORD, SHARD_WIDTH
 
 # ------------------------------------------------------------- host packing
 

@@ -45,7 +45,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..constants import VIEW_BSI_GROUP_PREFIX, WORDS_PER_ROW
-from ..errors import PilosaError, QueryError
+from ..errors import PilosaError
 from .distributed import SHARD_AXIS, global_mesh
 
 DEFAULT_TIMEOUT_MS = int(os.environ.get("PILOSA_COLLECTIVE_TIMEOUT_MS", "10000"))

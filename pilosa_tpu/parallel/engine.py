@@ -16,7 +16,6 @@ Everything else falls back to the executor's per-shard path.
 
 from __future__ import annotations
 
-import functools
 import os
 import threading
 from dataclasses import dataclass
@@ -26,12 +25,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..constants import SHARD_WIDTH, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD, WORDS_PER_ROW
+from ..constants import VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD, WORDS_PER_ROW
 from ..core.row import Row
 from ..errors import FieldNotFoundError, BSIGroupNotFoundError, QueryError
 from ..ops import bitplane as bp
-from ..pql.ast import BETWEEN, Call, Condition, GT, GTE, LT, LTE, NEQ
-from .mesh import SHARD_AXIS, default_mesh, pad_shards, replicated, shard_sharding
+from ..pql.ast import BETWEEN, Call, GT, GTE, LT, LTE, NEQ
+from .mesh import default_mesh, pad_shards, shard_sharding
 
 
 @dataclass(frozen=True)

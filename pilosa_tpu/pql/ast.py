@@ -8,7 +8,7 @@ eq, neq, lt, lte, gt, gte, between.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 # Condition ops.
 EQ = "eq"

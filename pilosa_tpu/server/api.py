@@ -11,9 +11,8 @@ from __future__ import annotations
 from datetime import datetime
 from typing import Any, Dict, List, Optional, Sequence
 
-import numpy as np
 
-from ..cluster.node import STATE_NORMAL, STATE_RESIZING, STATE_STARTING
+from ..cluster.node import STATE_NORMAL
 from ..constants import SHARD_WIDTH
 from ..core.field import FieldOptions
 from ..core.index import IndexOptions

@@ -8,7 +8,6 @@ Uses stdlib urllib (JSON wire).
 
 from __future__ import annotations
 
-import io
 import json
 import urllib.error
 import urllib.parse

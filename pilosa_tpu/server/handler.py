@@ -15,14 +15,14 @@ import threading
 import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..core.cache import Pair
 from ..core.row import Row
 from ..errors import PilosaError
 from ..executor import ValCount
-from .api import API, serialize_result
+from .api import API
 
 
 def serialize_remote(r) -> dict:

@@ -13,14 +13,14 @@ import os
 import threading
 import time
 import uuid
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from ..cluster.node import Cluster, Node, STATE_NORMAL, STATE_RESIZING, STATE_STARTING
 from ..core.holder import Holder
 from ..errors import PilosaError
 from ..executor import Executor
-from ..logger import Logger, NopLogger
-from ..stats import InMemoryStatsClient, NopStatsClient
+from ..logger import NopLogger
+from ..stats import InMemoryStatsClient
 from ..translate import TranslateStore
 from .api import API
 from .client import ClientError, InternalClient

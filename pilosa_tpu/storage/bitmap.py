@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import io
 import struct
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
