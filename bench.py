@@ -24,7 +24,7 @@ BENCH_PROBE_TIMEOUT (per-attempt seconds, default 150),
 BENCH_PROBE_ATTEMPTS (default 3), BENCH_REQUIRE_TPU=1 (fail instead of
 CPU fallback), BENCH_FORCE_PLATFORM, BENCH_HBM_GIB (resident-stack size
 for the bandwidth stanza; default 8 on TPU / 0.125 on CPU), and
-BENCH_{HBM,SCALE,OPEN,SERVING,TOPN_BSI,TIME_RANGE}=0 to skip a stanza
+BENCH_{HBM,SCALE,OPEN,IMPORT,SERVING,TOPN_BSI,TIME_RANGE}=0 to skip a stanza
 (the Pallas-vs-XLA kernel race lives inside the HBM stanza).
 """
 
@@ -662,6 +662,61 @@ def bench_serving():
     return out
 
 
+# ------------------------------------------------------- import stanza
+
+
+def bench_import():
+    """Bulk-import + snapshot throughput (BASELINE.md rows: Fragment
+    Import / Snapshot, reference fragment_internal_test.go:1146-1240).
+    Random bits exercise the scatter/union path; contiguous bits must
+    runify (run-form compression) instead of inflating host memory."""
+    import tempfile
+
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.storage.bitmap import _as_container
+
+    rng = np.random.default_rng(21)
+    out = {}
+    with tempfile.TemporaryDirectory() as d:
+        # Random scatter: n_rows x bits_per_row over the full shard width.
+        n_rows, per_row = 64, 80_000
+        rows = np.repeat(np.arange(n_rows, dtype=np.uint64), per_row)
+        cols = rng.integers(0, SHARD_WIDTH, rows.size, dtype=np.uint64)
+        f = Fragment(os.path.join(d, "rand"), "i", "f", "standard", 0)
+        f.open()
+        t0 = time.perf_counter()
+        f.bulk_import(rows, cols)
+        dt = time.perf_counter() - t0
+        out["random_mbits_per_s"] = round(rows.size / dt / 1e6, 2)
+        t0 = time.perf_counter()
+        f.snapshot()
+        out["snapshot_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        out["random_file_mib"] = round(
+            os.path.getsize(os.path.join(d, "rand")) / 2**20, 2)
+        f.close()
+
+        # Contiguous: the adversarial-RLE shape; must land as runs.
+        n_bits = n_rows * per_row
+        rows2 = np.repeat(np.arange(8, dtype=np.uint64), n_bits // 8)
+        cols2 = np.tile(np.arange(n_bits // 8, dtype=np.uint64), 8)
+        f2 = Fragment(os.path.join(d, "contig"), "i", "f", "standard", 0)
+        f2.open()
+        t0 = time.perf_counter()
+        f2.bulk_import(rows2, cols2)
+        dt = time.perf_counter() - t0
+        out["contig_mbits_per_s"] = round(rows2.size / dt / 1e6, 2)
+        run_containers = sum(
+            1 for c in f2.storage.containers.values()
+            if _as_container(c).runs is not None
+        )
+        out["contig_run_containers"] = run_containers
+        out["contig_file_kib"] = round(
+            os.path.getsize(os.path.join(d, "contig")) / 1024, 1)
+        f2.close()
+    return out
+
+
 # --------------------------------------------- north-star ladder stanzas
 
 
@@ -973,6 +1028,7 @@ def main():
     hbm = stanza("HBM", bench_hbm)
     scale = stanza("SCALE", bench_scale)
     open_stanza = stanza("OPEN", bench_open)
+    import_stanza = stanza("IMPORT", bench_import)
     serving = stanza("SERVING", bench_serving)
     topn_bsi = stanza("TOPN_BSI", bench_topn_bsi)
     time_range = stanza("TIME_RANGE", bench_time_range)
@@ -1008,6 +1064,7 @@ def main():
             "pallas": pallas,
             "scale": scale,
             "open": open_stanza,
+            "import": import_stanza,
             "serving": serving,
             "topn_bsi": topn_bsi,
             "time_range": time_range,
