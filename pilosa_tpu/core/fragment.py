@@ -20,6 +20,7 @@ from __future__ import annotations
 import heapq
 import os
 import struct
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -112,6 +113,14 @@ class Fragment:
 
         self.storage = Bitmap()
         self.op_n = 0
+        # Write mutex (reference fragment.go f.mu): the HTTP server applies
+        # writes from many threads, and container mutations are multi-step
+        # numpy read-modify-write sequences that would otherwise interleave
+        # and lose updates. Reads stay lock-free — form transitions assign
+        # the new form before clearing the old so a concurrent reader
+        # always sees a value-complete container, and the engine's
+        # generation counters handle staleness.
+        self._mu = threading.RLock()
         self._wal = None  # append handle to the storage file
         self._plane_cache: Dict[int, jnp.ndarray] = {}
         self._checksums: Dict[int, bytes] = {}
@@ -147,11 +156,15 @@ class Fragment:
         self._opened = True
 
     def close(self) -> None:
-        self._flush_cache()
-        if self._wal:
-            self._wal.close()
-            self._wal = None
-        self._opened = False
+        # Under the mutex: closing the WAL out from under a writer inside
+        # _append_op would drop the op from disk after the in-memory
+        # mutation already landed.
+        with self._mu:
+            self._flush_cache()
+            if self._wal:
+                self._wal.close()
+                self._wal = None
+            self._opened = False
 
     # ------------------------------------------------------------ positions
 
@@ -194,7 +207,10 @@ class Fragment:
 
     def rows(self) -> List[int]:
         """Row ids with at least one bit set."""
-        seen = sorted({(key << 16) // SHARD_WIDTH for key in self.storage.containers})
+        # list() snapshots the key set in one C-level call; a python-level
+        # iteration would raise if a locked writer inserts a container.
+        keys = list(self.storage.containers)
+        seen = sorted({(int(key) << 16) // SHARD_WIDTH for key in keys})
         return [int(r) for r in seen]
 
     def bit(self, row_id: int, column_id: int) -> bool:
@@ -208,25 +224,27 @@ class Fragment:
         self.generation += 1
 
     def set_bit(self, row_id: int, column_id: int) -> bool:
-        pos = self.pos(row_id, column_id)
-        changed = self.storage.add(pos)
-        if not changed:
-            return False
-        self._append_op(OP_ADD, pos)
-        self._invalidate_row(row_id)
-        self.cache.add(row_id, self.row_count(row_id))
+        with self._mu:
+            pos = self.pos(row_id, column_id)
+            changed = self.storage.add(pos)
+            if not changed:
+                return False
+            self._append_op(OP_ADD, pos)
+            self._invalidate_row(row_id)
+            self.cache.add(row_id, self.row_count(row_id))
         if self.stats:
             self.stats.count("setBit", 1)
         return True
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
-        pos = self.pos(row_id, column_id)
-        changed = self.storage.remove(pos)
-        if not changed:
-            return False
-        self._append_op(OP_REMOVE, pos)
-        self._invalidate_row(row_id)
-        self.cache.add(row_id, self.row_count(row_id))
+        with self._mu:
+            pos = self.pos(row_id, column_id)
+            changed = self.storage.remove(pos)
+            if not changed:
+                return False
+            self._append_op(OP_REMOVE, pos)
+            self._invalidate_row(row_id)
+            self.cache.add(row_id, self.row_count(row_id))
         if self.stats:
             self.stats.count("clearBit", 1)
         return True
@@ -252,15 +270,20 @@ class Fragment:
         return value, True
 
     def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
-        """Write a BSI value bit-by-bit (reference fragment.go:492-520)."""
-        changed = False
-        for i in range(bit_depth):
-            if (value >> i) & 1:
-                changed |= self.set_bit(i, column_id)
-            else:
-                changed |= self.clear_bit(i, column_id)
-        changed |= self.set_bit(bit_depth, column_id)
-        return changed
+        """Write a BSI value bit-by-bit (reference fragment.go:492-520).
+
+        The whole composite holds the write mutex: per-bit locking alone
+        would let two concurrent set_values interleave and store a torn
+        value neither thread wrote."""
+        with self._mu:
+            changed = False
+            for i in range(bit_depth):
+                if (value >> i) & 1:
+                    changed |= self.set_bit(i, column_id)
+                else:
+                    changed |= self.clear_bit(i, column_id)
+            changed |= self.set_bit(bit_depth, column_id)
+            return changed
 
     def _bsi_planes(self, bit_depth: int) -> jnp.ndarray:
         return self.plane_stack(list(range(bit_depth + 1)))
@@ -489,7 +512,7 @@ class Fragment:
         containers_per_block = block_width >> 16
         out = []
         by_block: Dict[int, List[int]] = {}
-        for key in sorted(self.storage.containers):
+        for key in sorted(list(self.storage.containers)):
             by_block.setdefault(int(key) // containers_per_block, []).append(int(key))
         for bid in sorted(by_block):
             cached = self._checksums.get(bid)
@@ -497,7 +520,10 @@ class Fragment:
                 h = _block_hasher()
                 any_bits = False
                 for key in by_block[bid]:
-                    c = _as_container(self.storage.containers[key])
+                    raw = self.storage.containers.get(key)
+                    if raw is None:  # dropped by a concurrent writer
+                        continue
+                    c = _as_container(raw)
                     vals = c.to_array()
                     if not len(vals):
                         continue
@@ -554,48 +580,49 @@ class Fragment:
         included. Returns (sets, clears) diffs per input replica, majority
         vote over {local} ∪ replicas, and applies the local diff.
         """
-        # Vote on flat bit positions with numpy set ops — a dense 100-row
-        # block holds up to 100 * 2^20 bits, so per-pair Python objects
-        # (sets of tuples) are out of the question at scale.
-        block_width = HASH_BLOCK_SIZE * SHARD_WIDTH
-        base_pos = np.uint64(block_id * block_width)
-        local_pos = self.storage.slice_range(
-            block_id * block_width, (block_id + 1) * block_width
-        ) - base_pos
-        positions = [local_pos]
-        for rows, cols in data:
-            pos = np.asarray(rows, dtype=np.uint64) * np.uint64(SHARD_WIDTH) + np.asarray(
-                cols, dtype=np.uint64
+        with self._mu:
+            # Vote on flat bit positions with numpy set ops — a dense 100-row
+            # block holds up to 100 * 2^20 bits, so per-pair Python objects
+            # (sets of tuples) are out of the question at scale.
+            block_width = HASH_BLOCK_SIZE * SHARD_WIDTH
+            base_pos = np.uint64(block_id * block_width)
+            local_pos = self.storage.slice_range(
+                block_id * block_width, (block_id + 1) * block_width
             ) - base_pos
-            # Drop replica pairs outside this block: below-block positions
-            # wrap uint64 to huge values and above-block ones exceed the
-            # width, so a single bound check rejects both. Without it,
-            # wrapped garbage can reach consensus and persist phantom rows
-            # at arbitrary local bit positions.
-            pos = pos[pos < np.uint64(block_width)]
-            positions.append(np.unique(pos))
-        # Even splits keep the bit (reference fragment.go:1218 majorityN =
-        # (n+1)/2 with setN >= majorityN).
-        majority = (len(positions) + 1) // 2
-        uniq, counts = np.unique(np.concatenate(positions), return_counts=True)
-        consensus = uniq[counts >= majority]
+            positions = [local_pos]
+            for rows, cols in data:
+                pos = np.asarray(rows, dtype=np.uint64) * np.uint64(SHARD_WIDTH) + np.asarray(
+                    cols, dtype=np.uint64
+                ) - base_pos
+                # Drop replica pairs outside this block: below-block positions
+                # wrap uint64 to huge values and above-block ones exceed the
+                # width, so a single bound check rejects both. Without it,
+                # wrapped garbage can reach consensus and persist phantom rows
+                # at arbitrary local bit positions.
+                pos = pos[pos < np.uint64(block_width)]
+                positions.append(np.unique(pos))
+            # Even splits keep the bit (reference fragment.go:1218 majorityN =
+            # (n+1)/2 with setN >= majorityN).
+            majority = (len(positions) + 1) // 2
+            uniq, counts = np.unique(np.concatenate(positions), return_counts=True)
+            consensus = uniq[counts >= majority]
 
-        def pairs(pos: np.ndarray) -> List[Tuple[int, int]]:
-            p = pos + base_pos
-            rows = (p // np.uint64(SHARD_WIDTH)).tolist()
-            cols = (p % np.uint64(SHARD_WIDTH)).tolist()
-            return list(zip(map(int, rows), map(int, cols)))
+            def pairs(pos: np.ndarray) -> List[Tuple[int, int]]:
+                p = pos + base_pos
+                rows = (p // np.uint64(SHARD_WIDTH)).tolist()
+                cols = (p % np.uint64(SHARD_WIDTH)).tolist()
+                return list(zip(map(int, rows), map(int, cols)))
 
-        sets_out, clears_out = [], []
-        for i, pos in enumerate(positions):
-            add = np.setdiff1d(consensus, pos, assume_unique=True)
-            rem = np.setdiff1d(pos, consensus, assume_unique=True)
-            if i == 0:
-                self._apply_merge_diff(add + base_pos, rem + base_pos)
-            else:
-                sets_out.append(pairs(add))
-                clears_out.append(pairs(rem))
-        return sets_out, clears_out
+            sets_out, clears_out = [], []
+            for i, pos in enumerate(positions):
+                add = np.setdiff1d(consensus, pos, assume_unique=True)
+                rem = np.setdiff1d(pos, consensus, assume_unique=True)
+                if i == 0:
+                    self._apply_merge_diff(add + base_pos, rem + base_pos)
+                else:
+                    sets_out.append(pairs(add))
+                    clears_out.append(pairs(rem))
+            return sets_out, clears_out
 
     # Above this many local diff bits, anti-entropy applies the merge in
     # bulk (storage-level scatter + one snapshot) instead of per-bit
@@ -631,30 +658,32 @@ class Fragment:
         positions = row_ids * np.uint64(SHARD_WIDTH) + (
             column_ids % np.uint64(SHARD_WIDTH)
         )
-        self.storage.add_many(positions)
-        for row_id in np.unique(row_ids):
-            self._invalidate_row(int(row_id))
-            self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
-        self.cache.invalidate(force=True)
-        self.snapshot()
+        with self._mu:
+            self.storage.add_many(positions)
+            for row_id in np.unique(row_ids):
+                self._invalidate_row(int(row_id))
+                self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
+            self.cache.invalidate(force=True)
+            self.snapshot()
 
     def import_value(
         self, column_ids: np.ndarray, values: np.ndarray, bit_depth: int
     ) -> None:
         """Bulk BSI import (reference fragment.go:1361-1397)."""
-        column_ids = np.asarray(column_ids, dtype=np.uint64) % np.uint64(SHARD_WIDTH)
-        values = np.asarray(values, dtype=np.uint64)
-        for i in range(bit_depth):
-            mask = (values >> np.uint64(i)) & np.uint64(1)
-            on = column_ids[mask == 1]
-            off = column_ids[mask == 0]
-            base = np.uint64(i * SHARD_WIDTH)
-            self.storage.add_many(on + base)
-            self.storage.remove_many(off + base)
-            self._invalidate_row(i)
-        self.storage.add_many(column_ids + np.uint64(bit_depth * SHARD_WIDTH))
-        self._invalidate_row(bit_depth)
-        self.snapshot()
+        with self._mu:
+            column_ids = np.asarray(column_ids, dtype=np.uint64) % np.uint64(SHARD_WIDTH)
+            values = np.asarray(values, dtype=np.uint64)
+            for i in range(bit_depth):
+                mask = (values >> np.uint64(i)) & np.uint64(1)
+                on = column_ids[mask == 1]
+                off = column_ids[mask == 0]
+                base = np.uint64(i * SHARD_WIDTH)
+                self.storage.add_many(on + base)
+                self.storage.remove_many(off + base)
+                self._invalidate_row(i)
+            self.storage.add_many(column_ids + np.uint64(bit_depth * SHARD_WIDTH))
+            self._invalidate_row(bit_depth)
+            self.snapshot()
 
     # ---------------------------------------------------------- persistence
 
@@ -664,21 +693,22 @@ class Fragment:
         Also re-compresses RLE-heavy containers to the run form (reference
         Optimize) so point-mutation churn between snapshots doesn't leave
         8 KiB bitsets where 4-byte interval lists suffice."""
-        self.storage.optimize()
-        if not self.path:
+        with self._mu:
+            self.storage.optimize()
+            if not self.path:
+                self.op_n = 0
+                return
+            if self._wal:
+                self._wal.close()
+                self._wal = None
+            tmp = self.path + ".snapshotting"
+            with open(tmp, "wb") as f:
+                self.storage.write_to(f)
+            os.replace(tmp, self.path)
             self.op_n = 0
-            return
-        if self._wal:
-            self._wal.close()
-            self._wal = None
-        tmp = self.path + ".snapshotting"
-        with open(tmp, "wb") as f:
-            self.storage.write_to(f)
-        os.replace(tmp, self.path)
-        self.op_n = 0
-        self._wal = open(self.path, "ab")
-        if self.stats:
-            self.stats.count("snapshot", 1)
+            self._wal = open(self.path, "ab")
+            if self.stats:
+                self.stats.count("snapshot", 1)
 
     def cache_path(self) -> Optional[str]:
         return self.path + ".cache" if self.path else None
@@ -708,7 +738,8 @@ class Fragment:
         self.cache.invalidate(force=True)
 
     def flush_cache(self) -> None:
-        self._flush_cache()
+        with self._mu:  # cache.ids() must not race writers' cache.add
+            self._flush_cache()
 
     # ----------------------------------------------------------- shard ship
 
@@ -719,14 +750,16 @@ class Fragment:
         f.write(data)
 
     def read_from(self, f) -> None:
-        (n,) = struct.unpack("<Q", f.read(8))
-        self.storage = Bitmap.from_bytes(f.read(n))
-        self.op_n = 0
-        self._plane_cache.clear()
-        self._checksums.clear()
-        self.cache.clear()
-        for row_id in self.rows():
-            self.cache.bulk_add(row_id, self.row_count(row_id))
-        self.cache.invalidate(force=True)
-        if self.path:
-            self.snapshot()
+        with self._mu:
+            (n,) = struct.unpack("<Q", f.read(8))
+            self.storage = Bitmap.from_bytes(f.read(n))
+            self.op_n = 0
+            self._plane_cache.clear()
+            self._checksums.clear()
+            self.cache.clear()
+            self.generation += 1
+            for row_id in self.rows():
+                self.cache.bulk_add(row_id, self.row_count(row_id))
+            self.cache.invalidate(force=True)
+            if self.path:
+                self.snapshot()

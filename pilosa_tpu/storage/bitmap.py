@@ -895,8 +895,12 @@ class Bitmap:
     # ---------------------------------------------------------- serialization
 
     def to_bytes(self) -> bytes:
+        # list() first: a C-level snapshot of the key set, so serialization
+        # racing a concurrent writer's container insert cannot raise
+        # mid-iteration (fragment reads are lock-free by design).
         items = sorted(
-            (k, _as_container(c)) for k, c in self.containers.items() if len(_as_container(c))
+            (k, _as_container(c)) for k, c in list(self.containers.items())
+            if len(_as_container(c))
         )
         buf = io.BytesIO()
         buf.write(struct.pack("<II", COOKIE, len(items)))
