@@ -69,7 +69,7 @@ class RankCache:
         return self.entries.get(row_id, 0)
 
     def ids(self) -> List[int]:
-        return sorted(self.entries)
+        return sorted(list(self.entries))
 
     def __len__(self) -> int:
         return len(self.entries)
@@ -80,7 +80,12 @@ class RankCache:
             now - self._last_invalidate < RANK_CACHE_INVALIDATE_SECONDS
         ):
             return
-        ranked = sort_pairs([Pair(id=i, count=c) for i, c in self.entries.items()])
+        # list() snapshots entries in one C-level call: TopN reads are
+        # lock-free and must not raise if a fragment writer (who holds the
+        # fragment mutex, not ours) inserts mid-iteration.
+        ranked = sort_pairs(
+            [Pair(id=i, count=c) for i, c in list(self.entries.items())]
+        )
         if len(ranked) > self.max_entries:
             ranked = ranked[: self.max_entries]
             self.entries = {p.id: p.count for p in ranked}
@@ -120,7 +125,7 @@ class LRUCache:
         return n
 
     def ids(self) -> List[int]:
-        return sorted(self.entries)
+        return sorted(list(self.entries))
 
     def __len__(self) -> int:
         return len(self.entries)
@@ -129,7 +134,9 @@ class LRUCache:
         pass
 
     def top(self) -> List[Pair]:
-        return sort_pairs([Pair(id=i, count=c) for i, c in self.entries.items()])
+        return sort_pairs(
+            [Pair(id=i, count=c) for i, c in list(self.entries.items())]
+        )
 
     def clear(self) -> None:
         self.entries.clear()
