@@ -65,9 +65,10 @@ def batched_gather_expr_count(stacked, idxs, expr):
     copy multiplies the memory traffic. One grid step covers a whole
     (S, W) leaf plane — a single large contiguous DMA per leaf — unless
     that would blow the VMEM budget, in which case the W axis is chunked.
-    Caller is responsible for sharding (single-device stacks only; the
-    multi-device mesh path uses the XLA fallback, whose NamedShardings XLA
-    partitions).
+    The kernel operates on ONE device's arrays: multi-device callers run
+    it per device under shard_map on each local (U, S/d, W) shard-block
+    and psum the per-query partials (parallel/engine.py
+    _count_batch_setops).
     """
     u, s, w = stacked.shape
     l = len(idxs)

@@ -30,7 +30,7 @@ from ..core.row import Row
 from ..errors import FieldNotFoundError, BSIGroupNotFoundError, QueryError
 from ..ops import bitplane as bp
 from ..pql.ast import BETWEEN, Call, GT, GTE, LT, LTE, NEQ
-from .mesh import default_mesh, pad_shards, shard_sharding
+from .mesh import SHARD_AXIS, default_mesh, pad_shards, shard_sharding
 
 
 @dataclass(frozen=True)
@@ -699,8 +699,43 @@ class ShardedQueryEngine:
             if self._use_gather_kernel():
                 from ..ops import pallas_kernels as pk
 
-                def counts_of(stacked, idxs):
-                    return pk.batched_gather_expr_count(stacked, idxs, expr)
+                if self.n_devices == 1:
+                    def counts_of(stacked, idxs):
+                        return pk.batched_gather_expr_count(stacked, idxs, expr)
+                else:
+                    # Multi-device: the kernel runs per device on its local
+                    # (U, S/d, W) shard-block under shard_map; per-query
+                    # partial counts reduce with one psum over the shard
+                    # axis (ICI). This keeps the no-materialization win on
+                    # every chip — the XLA fallback's gather copies cost 3x
+                    # the HBM traffic per device.
+                    try:
+                        from jax import shard_map
+                    except ImportError:  # older jax
+                        from jax.experimental.shard_map import shard_map
+                    from jax.sharding import PartitionSpec as P
+
+                    def local(stacked_blk, *ix):
+                        c = pk.batched_gather_expr_count(stacked_blk, ix, expr)
+                        return jax.lax.psum(c, SHARD_AXIS)
+
+                    specs = (P(None, SHARD_AXIS, None),) + (P(),) * len(idxs)
+                    # check_vma/check_rep off (name depends on jax version):
+                    # pallas_call inside shard_map cannot express output
+                    # variance, and the psum makes the result replicated by
+                    # construction.
+                    for knob in ("check_vma", "check_rep"):
+                        try:
+                            smap = shard_map(
+                                local, mesh=self.mesh, in_specs=specs,
+                                out_specs=P(), **{knob: False},
+                            )
+                            break
+                        except TypeError:
+                            continue
+
+                    def counts_of(stacked, idxs):
+                        return smap(stacked, *idxs)
             else:
                 # XLA fallback: materializes the (Q, S, W) gathers but
                 # partitions cleanly over a multi-device mesh.
@@ -728,9 +763,10 @@ class ShardedQueryEngine:
         return fn(stacked, idxs)
 
     def _use_gather_kernel(self) -> bool:
-        """Fused Pallas gather kernel: single-device TPU only (the
-        multi-device path relies on XLA partitioning of the fallback;
-        interpret mode would crawl at real plane widths)."""
+        """Fused Pallas gather kernel on TPU (any mesh size: multi-device
+        runs the kernel per device under shard_map with a psum reduce).
+        PILOSA_PALLAS_BATCH forces it on (tests use interpret mode) or
+        off (XLA gather fallback)."""
         env = os.environ.get("PILOSA_PALLAS_BATCH")
         if env is not None:
             v = env.strip().lower()
@@ -739,8 +775,6 @@ class ShardedQueryEngine:
             if v in ("", "0", "false", "no", "off"):
                 return False
             # Unrecognized value: fall through to the default gates.
-        if self.mesh.devices.size != 1:
-            return False
         from ..ops import pallas_kernels as pk
 
         return pk._on_tpu() and WORDS_PER_ROW % 128 == 0

@@ -440,3 +440,31 @@ def test_bsi_val_count_memo_and_invalidation(holder, ex):
     ex.execute("i", "SetValue(col=3, v=9)")
     counts3 = engine.bsi_val_count("i", "v", "sum", depth, [0])
     assert int(counts3[depth]) == int(counts1[depth]) + 1
+
+
+def test_gather_kernel_multi_device_shard_map(holder, ex, monkeypatch):
+    """The Pallas gather kernel partitions over a multi-device mesh via
+    shard_map + psum: batched counts forced onto the kernel (interpret
+    mode on CPU) must equal the XLA-fallback singles on the 8-device
+    mesh."""
+    expected = plant(holder, ex, n_shards=8)
+    engine = ShardedQueryEngine(holder)
+    assert engine.n_devices == 8
+    shards = list(range(8))
+    pairs = [("f", 1, "g", 3), ("f", 1, "f", 2), ("f", 2, "g", 3)]
+    calls = [
+        parse(f"Intersect(Row({fa}={ra}), Row({fb}={rb}))").calls[0]
+        for fa, ra, fb, rb in pairs
+    ]
+    singles = [engine.count("i", c, shards) for c in calls]
+    # Anchor to planted ground truth so a bug shared by both device paths
+    # cannot hide.
+    want = [
+        len(expected[(fa, ra)] & expected[(fb, rb)]) for fa, ra, fb, rb in pairs
+    ]
+    assert singles == want
+
+    monkeypatch.setenv("PILOSA_PALLAS_BATCH", "1")
+    kernel_engine = ShardedQueryEngine(holder)
+    got = kernel_engine.count_batch("i", calls, shards)
+    assert got.tolist() == singles
