@@ -109,7 +109,13 @@ class InternalClient:
         # Binary data plane when the peer speaks it (packed bitplanes);
         # JSON fallback keeps mixed-version clusters working.
         if wire.is_wire(raw):
-            return wire.decode_results(raw)
+            try:
+                return wire.decode_results(raw)
+            except ValueError as e:
+                # A corrupt body is a NODE fault: status 0 routes it
+                # through the executor's replica-retry classification
+                # instead of killing the whole query.
+                raise ClientError(f"corrupt wire body from {url}: {e}") from e
         data = json.loads(raw)
         if "error" in data:
             # The peer executed the request and rejected it: a deterministic

@@ -101,6 +101,17 @@ def decode_results(data: bytes) -> List[Any]:
         if t == "row":
             segments = {}
             for shard, form, o, ln in h.get("segs", []):
+                # Bounds-check before slicing: a corrupt offset would
+                # otherwise wrap (negative) or silently truncate (past
+                # the end) into a wrong-but-plausible column list.
+                if (
+                    not isinstance(o, int) or not isinstance(ln, int)
+                    or isinstance(o, bool) or isinstance(ln, bool)
+                    or o < 0 or ln < 0 or blob_base + o + ln > len(data)
+                ):
+                    raise ValueError(
+                        f"bad blob span: off={o!r} len={ln!r} body={len(data)}"
+                    )
                 raw = data[blob_base + o : blob_base + o + ln]
                 if form == _FORM_PLANE:
                     words = np.frombuffer(raw, dtype="<u4")

@@ -62,3 +62,23 @@ def test_sparse_row_is_column_form():
 def test_decode_rejects_garbage():
     with pytest.raises(ValueError):
         wire.decode_results(b"{\"results\": []}")
+
+
+def test_corrupt_blob_span_rejected():
+    """Corrupt segment offsets must raise, not wrap (negative) or
+    silently truncate (past-the-end) into a plausible-looking Row."""
+    import json
+    import struct
+
+    body = wire.encode_results([Row(columns=[1, 5, 9])])
+    (head_len,) = struct.unpack_from("<I", body, 4)
+    header = json.loads(body[8 : 8 + head_len])
+    for bad_off, bad_len in ((-8, 8), (1 << 30, 8), (0, 1 << 30), ("x", 8)):
+        h = json.loads(json.dumps(header))
+        h["results"][0]["segs"][0][2] = bad_off
+        h["results"][0]["segs"][0][3] = bad_len
+        new_head = json.dumps(h).encode()
+        forged = wire.MAGIC + struct.pack("<I", len(new_head)) + new_head \
+            + body[8 + head_len:]
+        with pytest.raises(ValueError, match="bad blob span|bad plane"):
+            wire.decode_results(forged)
