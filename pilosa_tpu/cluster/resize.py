@@ -122,14 +122,54 @@ class ResizeCoordinator:
                 "nodeURIs": node_uris,
                 "maxShards": max_shards,
             }
+            if self.job is not job:
+                return  # an earlier dispatch already aborted this job
             if node_id == cluster.node.id:
                 follow_resize_instruction(self.server, msg)
             else:
                 target = next((n for n in new.nodes if n.id == node_id), None)
                 if target is not None:
-                    self.server.client.send_message(target, msg)
+                    try:
+                        self.server.client.send_message(target, msg)
+                    except PilosaError as e:
+                        # An undeliverable instruction can never be acked:
+                        # abort now instead of hanging in RESIZING forever.
+                        self.abort(
+                            f"cannot deliver resize instruction to "
+                            f"{node_id}: {e}"
+                        )
+                        return
 
-    def complete(self, node_id: str) -> None:
+    def abort(self, reason: str) -> None:
+        """Abandon the running job: the membership never flipped (nodes
+        flip only on full completion), so the cluster returns to NORMAL on
+        the OLD topology and no node garbage-collects anything
+        (cluster.go:1247 job abort)."""
+        with self._lock:
+            job = self.job
+            self.job = None
+        if job is None:
+            return
+        self.server.logger.error("resize job %s aborted: %s", job.id, reason)
+        cluster = self.server.cluster
+        cluster.state = STATE_NORMAL
+        self.server.broadcast_message(
+            {
+                "type": "cluster-status",
+                "state": STATE_NORMAL,
+                "nodes": [n.to_dict() for n in cluster.nodes],
+            }
+        )
+
+    def complete(self, node_id: str, error: str = "",
+                 job_id: str = "") -> None:
+        with self._lock:
+            job = self.job
+        if job is None or (job_id and job_id != job.id):
+            return  # stale ack from an earlier (aborted) job
+        if error:
+            self.abort(f"node {node_id} failed its resize instruction: {error}")
+            return
         with self._lock:
             job = self.job
             if job is None:
@@ -161,6 +201,7 @@ def follow_resize_instruction(server, msg: dict) -> None:
         if idx is not None:
             idx.set_remote_max_shard(max_shard)
     node_uris = msg.get("nodeURIs", {})
+    errors = []
     for src in msg.get("sources", []):
         source_uri = node_uris.get(src["sourceNodeID"])
         if source_uri is None or src["sourceNodeID"] == server.cluster.node.id:
@@ -169,7 +210,17 @@ def follow_resize_instruction(server, msg: dict) -> None:
             data = server.client.retrieve_shard_from_uri(
                 source_uri, src["index"], src["field"], src["view"], src["shard"]
             )
-        except PilosaError:
+        except PilosaError as e:
+            # A fetch failure must ABORT the resize, not complete with
+            # holes: after completion every node garbage-collects shards
+            # it no longer owns, so at replica_n=1 a silently-skipped
+            # fragment would be lost when its old owner cleans up
+            # (reference cluster.go followResizeInstruction propagates the
+            # error and the coordinator aborts the job).
+            errors.append(
+                f"{src['index']}/{src['field']}/{src['view']}/{src['shard']} "
+                f"from {src['sourceNodeID']}: {e}"
+            )
             continue
         import io
 
@@ -185,6 +236,8 @@ def follow_resize_instruction(server, msg: dict) -> None:
         "jobID": msg.get("jobID"),
         "nodeID": server.cluster.node.id,
     }
+    if errors:
+        complete["error"] = "; ".join(errors[:4])
     if msg.get("coordinatorID") == server.cluster.node.id:
         mark_resize_instruction_complete(server, complete)
     else:
@@ -197,4 +250,7 @@ def follow_resize_instruction(server, msg: dict) -> None:
 def mark_resize_instruction_complete(server, msg: dict) -> None:
     coordinator = getattr(server, "resize_coordinator", None)
     if coordinator is not None:
-        coordinator.complete(msg.get("nodeID", ""))
+        coordinator.complete(
+            msg.get("nodeID", ""), error=msg.get("error", ""),
+            job_id=msg.get("jobID", ""),
+        )

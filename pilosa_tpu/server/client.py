@@ -9,6 +9,7 @@ Uses stdlib urllib (JSON wire).
 from __future__ import annotations
 
 import json
+import struct
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -111,11 +112,13 @@ class InternalClient:
         if wire.is_wire(raw):
             try:
                 return wire.decode_results(raw)
-            except ValueError as e:
-                # A corrupt body is a NODE fault: status 0 routes it
-                # through the executor's replica-retry classification
-                # instead of killing the whole query.
-                raise ClientError(f"corrupt wire body from {url}: {e}") from e
+            except (ValueError, KeyError, TypeError, struct.error) as e:
+                # A corrupt body is a NODE fault, whatever shape the
+                # corruption takes (bad spans, truncated frame, missing
+                # header fields): status 0 routes it through the
+                # executor's replica-retry classification instead of
+                # killing the whole query.
+                raise ClientError(f"corrupt wire body from {url}: {e!r}") from e
         data = json.loads(raw)
         if "error" in data:
             # The peer executed the request and rejected it: a deterministic

@@ -700,7 +700,15 @@ class Server:
         elif typ == "resize-instruction":
             from ..cluster.resize import follow_resize_instruction
 
-            follow_resize_instruction(self, msg)
+            # Asynchronously: fragment transfers can take minutes, and the
+            # coordinator's send_message must return as soon as the
+            # instruction is DELIVERED (a slow transfer is not an
+            # undeliverable instruction). The ack rides a resize-complete
+            # message when the work finishes (cluster.go:1179).
+            threading.Thread(
+                target=follow_resize_instruction, args=(self, msg),
+                name="resize-follower", daemon=True,
+            ).start()
         elif typ == "resize-complete":
             from ..cluster.resize import mark_resize_instruction_complete
 
@@ -728,5 +736,11 @@ class Server:
             )
 
     def resize_abort(self) -> None:
-        if self.cluster.state == STATE_RESIZING:
+        coordinator = getattr(self, "resize_coordinator", None)
+        if coordinator is not None and coordinator.job is not None:
+            # Drop the job too: state-only reset would leave the job live,
+            # block every future resize, and still flip membership when
+            # the in-flight followers eventually ack.
+            coordinator.abort("operator requested abort")
+        elif self.cluster.state == STATE_RESIZING:
             self.cluster.state = STATE_NORMAL

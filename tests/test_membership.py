@@ -253,3 +253,62 @@ def test_startup_quorum_refuses_unknown_host(tmp_path):
         assert s0.cluster.state == "STARTING"
     finally:
         s0.close()
+
+
+def test_resize_aborts_on_failed_fetch(tmp_path):
+    """A node that cannot retrieve a source fragment must abort the whole
+    resize (reference cluster.go followResizeInstruction error -> job
+    abort): completing with holes would lose the fragment at replica_n=1
+    when the old owner garbage-collects. The membership must stay on the
+    OLD topology and return to NORMAL."""
+    from pilosa_tpu.cluster.node import Node, STATE_NORMAL, STATE_RESIZING
+    from pilosa_tpu.cluster.resize import (
+        ResizeCoordinator,
+        ResizeJob,
+        follow_resize_instruction,
+    )
+    from pilosa_tpu.server.server import Server
+
+    s = Server(data_dir=str(tmp_path / "n0"), cache_flush_interval=0,
+               member_monitor_interval=0, executor_workers=0)
+    s.open()
+    try:
+        idx = s.holder.create_index("r")
+        idx.create_field("f")
+        s.executor.execute("r", "Set(1, f=1)")
+        coord = ResizeCoordinator(s)
+        s.resize_coordinator = coord
+        old_nodes = list(s.cluster.nodes)
+
+        # Path 1: an undeliverable instruction aborts begin() itself
+        # (otherwise the cluster hangs in RESIZING forever).
+        coord.begin(old_nodes + [Node(id="zz-new", uri="localhost:1")])
+        assert coord.job is None
+        assert s.cluster.state == STATE_NORMAL
+        assert [n.id for n in s.cluster.nodes] == [n.id for n in old_nodes]
+
+        # Path 2: a follower whose source fetch fails acks with an error;
+        # the coordinator aborts instead of completing with holes.
+        coord.job = ResizeJob("j1", {s.cluster.node.id: []}, old_nodes)
+        s.cluster.state = STATE_RESIZING
+        instr = {
+            "type": "resize-instruction",
+            "jobID": "j1",
+            "coordinatorID": s.cluster.node.id,
+            "schema": [],
+            "sources": [{
+                "index": "r", "field": "f", "view": "standard", "shard": 0,
+                "sourceNodeID": "dead-node",
+            }],
+            "nodeURIs": {"dead-node": "localhost:9"},  # nothing listening
+            "maxShards": {},
+        }
+        follow_resize_instruction(s, instr)  # acks with error -> abort
+
+        assert coord.job is None
+        assert s.cluster.state == STATE_NORMAL
+        assert [n.id for n in s.cluster.nodes] == [n.id for n in old_nodes]
+        # Data untouched.
+        assert s.executor.execute("r", "Count(Row(f=1))") == [1]
+    finally:
+        s.close()
