@@ -42,9 +42,19 @@ class HolderSyncer:
                     view = fld.view(view_name)
                     for shard in view.available_shards():
                         replicas = self._remote_replicas(index_name, shard)
-                        if replicas:
+                        if not replicas:
+                            continue
+                        try:
                             self._sync_fragment(
                                 index_name, field_name, view_name, shard, replicas
+                            )
+                        except PilosaError as e:
+                            # One fragment's failure (peer down mid-sync, an
+                            # oversized diff rejected) must not abort the
+                            # rest of the sweep.
+                            self.server.logger.error(
+                                "anti-entropy: %s/%s/%s/%s sync failed: %s",
+                                index_name, field_name, view_name, shard, e,
                             )
 
     # ---------------------------------------------------------------- attrs
@@ -113,10 +123,21 @@ class HolderSyncer:
                 continue
             if view == VIEW_STANDARD:
                 # Push standard-view diffs as Set/Clear PQL
-                # (fragment.go:1814-1903 — the reference only syncs this view).
+                # (fragment.go:1814-1903 — the reference only syncs this
+                # view). Chunked: one giant request for a large divergence
+                # would trip the peer's max_writes_per_request cap (5000)
+                # and the whole diff would be rejected.
                 calls = [f"Set({base + c}, {field}={r})" for r, c in add]
                 calls += [f"Clear({base + c}, {field}={r})" for r, c in rem]
-                self.client.query_node(node, index, " ".join(calls), remote=True)
+                # Chunk under the CONFIGURED write cap, not a hardcoded
+                # guess — a cluster run with a smaller cap would reject
+                # every chunk and never converge.
+                cap = getattr(self.server.executor, "max_writes_per_request", 0)
+                chunk = min(1000, cap) if cap and cap > 0 else 1000
+                for i in range(0, len(calls), chunk):
+                    self.client.query_node(
+                        node, index, " ".join(calls[i : i + chunk]), remote=True
+                    )
             else:
                 # Time/bsig views are unreachable via PQL writes; apply the
                 # diff through the view-addressed internal endpoint instead.

@@ -280,3 +280,26 @@ def test_resize_add_node_moves_data(tmp_path):
     finally:
         for s in servers:
             s.close()
+
+
+def test_anti_entropy_syncs_oversized_divergence(cluster2r):
+    """A divergence larger than max_writes_per_request (5000) must still
+    converge: the pushed Set/Clear diff is chunked, where a single giant
+    PQL request would be rejected by the peer's write cap and previously
+    aborted the whole sweep."""
+    client = InternalClient()
+    h0 = f"localhost:{cluster2r[0].port}"
+    client.create_index(h0, "big")
+    client.create_field(h0, "big", "f")
+    time.sleep(0.05)
+    client.query(h0, "big", "Set(1, f=1)")  # both replicas have the seed
+
+    # Diverge node 0 by 6500 bits applied directly to its fragment.
+    frag0 = cluster2r[0].holder.fragment("big", "f", "standard", 0)
+    cols = np.arange(10, 6510, dtype=np.uint64)
+    frag0.bulk_import(np.ones(len(cols), dtype=np.uint64), cols)
+    frag1 = cluster2r[1].holder.fragment("big", "f", "standard", 0)
+    assert frag1.row_count(1) == 1  # replica lagging
+
+    HolderSyncer(cluster2r[0]).sync_holder()
+    assert frag1.row_count(1) == frag0.row_count(1) == 6501
