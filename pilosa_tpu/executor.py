@@ -877,27 +877,26 @@ class Executor:
 
     # --------------------------------------------------------------- writes
 
-    def _for_shard_owners(self, index: str, c: Call, shard: int, opt: ExecOptions, local_fn):
-        """Apply a write locally and forward to other owners (executor.go:1109).
-
-        Replica failures are tolerated like the read path's mapper retry:
-        dead owners are marked unavailable and skipped, and the write
-        succeeds as long as at least one owner applied it — anti-entropy
-        repairs the lagging replica when it returns. Only if EVERY owner is
-        unreachable does the write raise."""
+    def tolerant_owner_fanout(self, index: str, shard: int, remote: bool,
+                              local_fn, forward_fn, on_forward_ok=None):
+        """THE write-tolerance policy, shared by PQL writes and bulk
+        imports (executor.go:1109): apply locally, forward to every other
+        owner, mark dead owners unavailable and skip them (anti-entropy
+        repairs a lagging replica when it returns), finish the whole loop
+        before surfacing a deterministic 4xx rejection (so one lagging
+        replica cannot cause extra divergence on the others), and raise
+        only if NO owner applied."""
         from .server.client import ClientError
 
-        ret = False
         applied = 0
         errors = []
         app_error = None
         for node in self.cluster.shard_nodes(index, shard):
             if node.id == self.node.id:
-                if local_fn():
-                    ret = True
+                local_fn()
                 applied += 1
                 continue
-            if opt.remote:
+            if remote:
                 applied += 1  # forwarding node already counted the write
                 continue
             if node.id in self.cluster.unavailable:
@@ -905,7 +904,7 @@ class Executor:
                 errors.append(f"{node.id}: unavailable")
                 continue
             try:
-                res = self.client.query_node(node, index, str(c), remote=True)
+                res = forward_fn(node)
             except ClientError as e:
                 if not _is_node_failure(e):
                     # The replica is alive and rejected the write (4xx):
@@ -920,8 +919,8 @@ class Executor:
                 errors.append(f"{node.id}: {e}")
                 continue
             applied += 1
-            if res and isinstance(res[0], bool):
-                ret = ret or res[0]
+            if on_forward_ok is not None:
+                on_forward_ok(res)
         if app_error is not None:
             raise app_error
         if applied == 0:
@@ -929,7 +928,27 @@ class Executor:
                 f"write failed on all owners of {index}/shard {shard}: "
                 + "; ".join(errors)
             )
-        return ret
+
+    def _for_shard_owners(self, index: str, c: Call, shard: int, opt: ExecOptions, local_fn):
+        """Apply a PQL write locally and forward to other owners — the
+        shared tolerant fan-out with query_node as the transport."""
+        out = {"ret": False}
+
+        def local():
+            if local_fn():
+                out["ret"] = True
+
+        def forward(node):
+            return self.client.query_node(node, index, str(c), remote=True)
+
+        def note(res):
+            if res and isinstance(res[0], bool):
+                out["ret"] = out["ret"] or res[0]
+
+        self.tolerant_owner_fanout(
+            index, shard, opt.remote, local, forward, on_forward_ok=note
+        )
+        return out["ret"]
 
     def _execute_set_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
         field_name = c.field_arg()

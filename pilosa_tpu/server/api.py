@@ -160,6 +160,16 @@ class API:
 
     # --------------------------------------------------------------- import
 
+    def _fan_out_import(self, index: str, shard: int, apply_local, send_remote,
+                        remote: bool) -> None:
+        """Bulk imports ride the executor's shared tolerant owner fan-out
+        (one source of truth for the cluster's write-tolerance policy:
+        dead replicas skipped + marked, deterministic rejections surfaced
+        after the loop, failure only when no owner applied)."""
+        self.executor.tolerant_owner_fanout(
+            index, shard, remote, apply_local, send_remote
+        )
+
     def import_bits(self, index: str, field: str, shard: int, row_ids, column_ids,
                     timestamps=None, remote: bool = False,
                     row_keys=None, column_keys=None) -> None:
@@ -224,16 +234,19 @@ class API:
             raise QueryError(
                 f"import timestamps length mismatch: {len(timestamps)} vs {n}"
             )
-        for node in self.cluster.shard_nodes(index, shard):
-            if node.id == self.cluster.node.id:
-                ts = None
-                if timestamps is not None and any(t for t in timestamps):
-                    ts = [_to_datetime(t) for t in timestamps]
-                fld.import_bits(row_ids, column_ids, ts)
-            elif not remote:
-                self.server.client.import_node(
-                    node, index, field, shard, row_ids, column_ids, timestamps
-                )
+        def apply_local():
+            ts = None
+            if timestamps is not None and any(t for t in timestamps):
+                ts = [_to_datetime(t) for t in timestamps]
+            fld.import_bits(row_ids, column_ids, ts)
+
+        self._fan_out_import(
+            index, shard, apply_local,
+            lambda node: self.server.client.import_node(
+                node, index, field, shard, row_ids, column_ids, timestamps
+            ),
+            remote,
+        )
 
     def import_values(self, index: str, field: str, shard: int, column_ids, values,
                       remote: bool = False, column_keys=None) -> None:
@@ -269,13 +282,13 @@ class API:
                 f"import columns/values length mismatch: "
                 f"{len(column_ids or [])} vs {len(values or [])}"
             )
-        for node in self.cluster.shard_nodes(index, shard):
-            if node.id == self.cluster.node.id:
-                fld.import_value(column_ids, values)
-            elif not remote:
-                self.server.client.import_value_node(
-                    node, index, field, shard, column_ids, values
-                )
+        self._fan_out_import(
+            index, shard, lambda: fld.import_value(column_ids, values),
+            lambda node: self.server.client.import_value_node(
+                node, index, field, shard, column_ids, values
+            ),
+            remote,
+        )
 
     # --------------------------------------------------------------- export
 

@@ -261,3 +261,28 @@ def test_legacy_topology_without_node_records_still_solicits():
         t = Topology.load(path)
         assert [n.id for n in t.nodes] == ["localhost:1001", "localhost:1002"]
         assert [n.uri for n in t.nodes] == ["localhost:1001", "localhost:1002"]
+
+
+def test_import_tolerates_dead_replica(cluster3r):
+    """Bulk import succeeds when a replica is down (the dead node is
+    marked unavailable and skipped, matching the executor's tolerant
+    write fan-out); previously the first ClientError failed the whole
+    import even though the primary had applied it."""
+    import numpy as np
+
+    client = InternalClient()
+    h0 = f"localhost:{cluster3r[0].port}"
+    client.create_index(h0, "imp")
+    client.create_field(h0, "imp", "f")
+    time.sleep(0.05)
+
+    owners = cluster3r[0].cluster.shard_nodes("imp", 0)
+    primary = next(s for s in cluster3r if s.node.id == owners[0].id)
+    replica = next(s for s in cluster3r if s.node.id == owners[1].id)
+    replica.close()  # replica dies
+
+    rows = np.zeros(100, dtype=np.uint64)
+    cols = np.arange(100, dtype=np.uint64)
+    primary.api.import_bits("imp", "f", 0, rows.tolist(), cols.tolist())
+    assert primary.holder.fragment("imp", "f", "standard", 0).row_count(0) == 100
+    assert replica.node.id in primary.cluster.unavailable
