@@ -193,7 +193,7 @@ class Field:
             json.dump(self.options.to_dict(), f)
 
     def close(self) -> None:
-        for view in self.views.values():
+        for view in list(self.views.values()):
             view.close()
         self.row_attr_store.close()
 
@@ -229,14 +229,14 @@ class Field:
             return view
 
     def view_names(self) -> List[str]:
-        return sorted(self.views)
+        return sorted(list(self.views))
 
     def max_shard(self) -> int:
-        return max((v.max_shard() for v in self.views.values()), default=0)
+        return max((v.max_shard() for v in list(self.views.values())), default=0)
 
     def available_shards(self) -> List[int]:
         shards = set()
-        for v in self.views.values():
+        for v in list(self.views.values()):
             shards.update(v.available_shards())
         return sorted(shards)
 

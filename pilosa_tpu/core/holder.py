@@ -43,7 +43,7 @@ class Holder:
         return self
 
     def close(self) -> None:
-        for index in self.indexes.values():
+        for index in list(self.indexes.values()):
             index.close()
         self.opened = False
 
@@ -130,8 +130,10 @@ class Holder:
 
     def flush_caches(self) -> None:
         """Persist all TopN caches (reference holder.go:425-461)."""
-        for index in self.indexes.values():
-            for field in index.fields.values():
-                for view in field.views.values():
-                    for frag in view.fragments.values():
+        # list() snapshots at every level: this runs on the periodic
+        # flusher thread while HTTP threads create indexes/fields/views.
+        for index in list(self.indexes.values()):
+            for field in list(index.fields.values()):
+                for view in list(field.views.values()):
+                    for frag in list(view.fragments.values()):
                         frag.flush_cache()

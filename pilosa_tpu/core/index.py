@@ -87,7 +87,7 @@ class Index:
             json.dump(self.options.to_dict(), f)
 
     def close(self) -> None:
-        for field in self.fields.values():
+        for field in list(self.fields.values()):
             field.close()
         self.column_attr_store.close()
 
@@ -135,10 +135,10 @@ class Index:
                 shutil.rmtree(field.path)
 
     def field_names(self) -> List[str]:
-        return sorted(self.fields)
+        return sorted(list(self.fields))
 
     def max_shard(self) -> int:
-        local = max((f.max_shard() for f in self.fields.values()), default=0)
+        local = max((f.max_shard() for f in list(self.fields.values())), default=0)
         return max(local, self.remote_max_shard)
 
     def set_remote_max_shard(self, shard: int) -> None:
@@ -147,7 +147,7 @@ class Index:
 
     def available_shards(self) -> List[int]:
         shards = set()
-        for f in self.fields.values():
+        for f in list(self.fields.values()):
             shards.update(f.available_shards())
         return sorted(shards) or [0]
 
@@ -155,5 +155,5 @@ class Index:
         return {
             "name": self.name,
             "options": self.options.to_dict(),
-            "fields": [f.to_info() for _, f in sorted(self.fields.items())],
+            "fields": [f.to_info() for _, f in sorted(list(self.fields.items()))],
         }

@@ -56,7 +56,7 @@ class View:
 
     def close(self) -> None:
         with self._lock:
-            for frag in self.fragments.values():
+            for frag in list(self.fragments.values()):
                 frag.close()
 
     def _fragment_path(self, shard: int) -> Optional[str]:
@@ -96,7 +96,7 @@ class View:
         return frag
 
     def available_shards(self) -> List[int]:
-        return sorted(self.fragments)
+        return sorted(list(self.fragments))
 
     def max_shard(self) -> int:
         return max(self.fragments, default=0)
