@@ -1000,6 +1000,36 @@ def bench_open():
 
 
 def main():
+    # Deadline watchdog: the tunnel can die MID-stanza, leaving a blocked
+    # device call that never returns — the driver would record no bench
+    # at all. At BENCH_DEADLINE seconds (default 40 min) the watchdog
+    # prints the JSON line with everything collected so far and exits.
+    import threading
+
+    deadline = float(os.environ.get("BENCH_DEADLINE", "2400"))
+    partial = {
+        "metric": "count_intersect_qps_8shards",
+        "value": 0,
+        "unit": "queries/sec",
+        "vs_baseline": 0,
+        "detail": {"partial": "deadline watchdog fired"},
+    }
+    state = {"done": False}
+
+    def watchdog():
+        time.sleep(deadline)
+        if state["done"]:
+            return
+        partial["detail"]["error"] = (
+            f"BENCH_DEADLINE {deadline}s exceeded; results are partial "
+            "(a device call likely blocked on a dead tunnel)"
+        )
+        print(json.dumps(partial), flush=True)
+        os._exit(3)
+
+    if deadline > 0:
+        threading.Thread(target=watchdog, daemon=True).start()
+
     n_shards = int(os.environ.get("BENCH_SHARDS", "8"))
     n_rows = int(os.environ.get("BENCH_ROWS", "128"))
     density = float(os.environ.get("BENCH_DENSITY", "0.02"))
@@ -1011,9 +1041,14 @@ def main():
 
     platform, probes = _ensure_live_backend()
     device = _device_info()
+    partial["detail"]["device"] = device
+    partial["detail"]["probes"] = probes
     holder, ex = build(n_shards, n_rows, density)
     count_qps, topn_qps = bench_device(ex, n_rows, n_shards, iters)
     host_qps, host_detail = bench_host(holder, n_rows, n_shards, iters)
+    partial["value"] = round(count_qps, 2)
+    partial["vs_baseline"] = round(count_qps / host_qps, 3)
+    partial["detail"]["host_cpu_qps"] = round(host_qps, 2)
 
     def stanza(name, fn):
         """Run one optional stanza; a crash records the error instead of
@@ -1021,9 +1056,11 @@ def main():
         if os.environ.get(f"BENCH_{name}") == "0":
             return {"skipped": f"BENCH_{name}=0"}
         try:
-            return fn()
+            out = fn()
         except Exception as e:
-            return {"error": f"{type(e).__name__}: {e}"[:500]}
+            out = {"error": f"{type(e).__name__}: {e}"[:500]}
+        partial["detail"][name.lower()] = out
+        return out
 
     hbm = stanza("HBM", bench_hbm)
     scale = stanza("SCALE", bench_scale)
@@ -1044,6 +1081,7 @@ def main():
     else:
         pallas = {"note": "kernel validation needs a TPU; see detail.hbm"}
 
+    state["done"] = True
     print(json.dumps({
         "metric": "count_intersect_qps_8shards",
         "value": round(count_qps, 2),
