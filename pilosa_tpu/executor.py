@@ -735,17 +735,23 @@ class Executor:
                     ]
                     if not run_ids:
                         return []
+                # Row (cache) counts only gate tanimoto and thresholds > 1:
+                # at thr<=1 the count>0 check below subsumes them, so the
+                # common phase-2 skips the candidate-plane popcount pass
+                # entirely (engine.topn_shard_counts need_row_counts).
+                need_rc = bool(tanimoto) or thr > 1
                 row_counts, inter, src_counts = self.engine.topn_shard_counts(
-                    index, field_name, run_ids, local_shards, src_call
+                    index, field_name, run_ids, local_shards, src_call,
+                    need_row_counts=need_rc,
                 )
                 pairs: Dict[int, int] = {}
                 for ri, row_id in enumerate(run_ids):
                     for si in range(len(local_shards)):
-                        cnt = int(row_counts[ri, si])
-                        if cnt <= 0:
-                            continue
-                        count = int(inter[ri, si]) if inter is not None else cnt
-                        if count == 0:
+                        # inter is never None here: this branch requires a
+                        # supported src_call.
+                        count = int(inter[ri, si])
+                        cnt = int(row_counts[ri, si]) if need_rc else count
+                        if cnt <= 0 or count == 0:
                             continue
                         if tanimoto:
                             tan = math.ceil(
@@ -809,8 +815,12 @@ class Executor:
                 CHUNK = 512  # bounds the (R, S, W) gather working set
                 for i in range(0, len(union), CHUNK):
                     chunk = union[i : i + CHUNK]
+                    # Ranking uses the cache counts already attached to the
+                    # candidates; the device program only computes the src
+                    # intersections (need_row_counts=False).
                     _, inter, src_counts = self.engine.topn_shard_counts(
-                        index, field_name, chunk, shard_list, src_call
+                        index, field_name, chunk, shard_list, src_call,
+                        need_row_counts=False,
                     )
                     for si, s in enumerate(shard_list):
                         src_count_by_shard[s] = int(src_counts[si])

@@ -794,6 +794,7 @@ class ShardedQueryEngine:
     def topn_shard_counts(
         self, index: str, field: str, row_ids: Sequence[int],
         shards: Sequence[int], src_call: Optional[Call] = None,
+        need_row_counts: bool = True,
     ):
         """Per-(row, shard) count matrices in one device program.
 
@@ -804,6 +805,12 @@ class ShardedQueryEngine:
         src call. Per-shard granularity preserves the reference's per-shard
         MinThreshold semantics (fragment.go:899-990) while batching all
         popcounts.
+
+        `need_row_counts=False` skips the candidate-plane popcount pass and
+        returns None row_counts: the executor's TopN phase-1 ranks with
+        cache counts and phase-2 at threshold<=1 needs only intersections,
+        so the common TopN query never pays for the (R, S, W) popcount —
+        only the fused AND+popcount program over the resident stack.
         """
         shards = tuple(shards)
         # Canonical (sorted, deduped) row order: the stacked tensor and the
@@ -822,7 +829,8 @@ class ShardedQueryEngine:
             comp, expr = self._compile(index, src_call)
             src_sig = tuple(comp.signature)
         mkey = ("topn_shard", index, field, tuple(canon_rows), shards,
-                src_sig, tuple(comp.leaves) if comp else None)
+                src_sig, tuple(comp.leaves) if comp else None,
+                need_row_counts)
         fp = tuple(self._fingerprint(index, leaf, shards) for leaf in leaves)
         if comp is not None:
             fp = fp + tuple(
@@ -832,7 +840,7 @@ class ShardedQueryEngine:
         def answer(value):
             row_counts, inter, src_counts = value
             return (
-                row_counts[sel],
+                row_counts[sel] if row_counts is not None else None,
                 inter[sel] if inter is not None else None,
                 src_counts,
             )
@@ -841,50 +849,73 @@ class ShardedQueryEngine:
         if hit is not None:
             return answer(hit)
 
-        rows_tensor = self._stacked_leaf_tensor(index, leaves, shards)  # (R, S, W)
+        # The candidate-plane popcounts (row_counts) are INDEPENDENT of the
+        # src call, so they memoize under their own key: a TopN stream with
+        # a varying filter (each query a new src row — the ChEMBL serving
+        # shape) pays for the (R, S, W) popcount pass at most once, and
+        # every subsequent query runs only the fused AND+popcount program
+        # below. Without this split each new src re-read the full candidate
+        # stack twice (r04: topn_qps 2.69 vs sum_qps 199 at the same shape).
+        # pad_pow2: phase-2 candidate counts vary per query (each query's
+        # winner set differs), so the row axis pads to a power of two to
+        # keep the compiled-program population at a handful of sizes.
+        rows_tensor = self._stacked_leaf_tensor(index, leaves, shards,
+                                                pad_pow2=True)  # (Rp, S, W)
+        r_real = len(canon_rows)
+        row_counts = None
+        if need_row_counts:
+            # Probe-time fingerprint discipline (see memo_probe): fp was
+            # computed BEFORE the gather above; its first len(leaves)
+            # entries are exactly the candidate-row fingerprints.
+            rows_fp = fp[: len(leaves)]
+            rkey = ("topn_rows", index, field, tuple(canon_rows), shards)
+            row_counts = self._aux_probe(rkey, rows_fp)
+            if row_counts is None:
+                sig = ("topn_shard", len(shards), rows_tensor.shape[0])
+
+                def build():
+                    @jax.jit
+                    def fn(stacked):
+                        return jnp.sum(
+                            jax.lax.population_count(stacked).astype(jnp.int32), axis=2
+                        )
+
+                    return fn
+
+                fn = self._fn_build(self._count_fns, sig, build)
+                row_counts = np.asarray(fn(rows_tensor))[:r_real, :s_real]
+                self._aux_store(rkey, rows_fp, row_counts)
+
         if src_call is not None:
             src_leaves = self._leaf_tensor(index, comp.leaves, shards)
-            sig = ("topn_shard_src", src_sig, len(shards), len(canon_rows))
+            sig = ("topn_shard_src", src_sig, len(shards), rows_tensor.shape[0])
 
             def build():
                 @jax.jit
                 def fn(stacked, src_lv):
-                    row_counts = jnp.sum(
-                        jax.lax.population_count(stacked).astype(jnp.int32), axis=2
-                    )
                     src = expr(src_lv)
                     src_counts = jnp.sum(
                         jax.lax.population_count(src).astype(jnp.int32), axis=1
                     )
+                    # AND+popcount+reduce fuses into one pass over the
+                    # stack — the masked plane is never materialized.
                     masked = jnp.bitwise_and(stacked, src[None, :, :])
                     inter = jnp.sum(
                         jax.lax.population_count(masked).astype(jnp.int32), axis=2
                     )
-                    return row_counts, inter, src_counts
+                    return inter, src_counts
 
                 return fn
 
             fn = self._fn_build(self._count_fns, sig, build)
-            row_counts, inter, src_counts = fn(rows_tensor, src_leaves)
+            inter, src_counts = fn(rows_tensor, src_leaves)
             value = (
-                np.asarray(row_counts)[:, :s_real],
-                np.asarray(inter)[:, :s_real],
+                row_counts,
+                np.asarray(inter)[:r_real, :s_real],
                 np.asarray(src_counts)[:s_real],
             )
         else:
-            sig = ("topn_shard", len(shards), len(canon_rows))
-
-            def build():
-                @jax.jit
-                def fn(stacked):
-                    return jnp.sum(
-                        jax.lax.population_count(stacked).astype(jnp.int32), axis=2
-                    )
-
-                return fn
-
-            fn = self._fn_build(self._count_fns, sig, build)
-            value = (np.asarray(fn(rows_tensor))[:, :s_real], None, None)
+            value = (row_counts, None, None)
         self._aux_store(mkey, fp, value)
         return answer(value)
 
@@ -917,11 +948,15 @@ class ShardedQueryEngine:
         if hit is not None:
             return hit[sel]
         leaves = leaves_fp
-        rows_tensor = self._stacked_leaf_tensor(index, leaves, shards)  # (R, S, W)
+        # pad_pow2: candidate-id counts vary per query; see topn_shard_counts.
+        rows_tensor = self._stacked_leaf_tensor(index, leaves, shards,
+                                                pad_pow2=True)  # (Rp, S, W)
+        r_real = len(row_ids)
         if src_call is not None:
             comp, expr = comp0, expr0  # compiled once above for the memo key
             src_leaves = self._leaf_tensor(index, comp.leaves, shards)
-            sig = ("topn_src", tuple(comp.signature), len(shards), len(row_ids))
+            sig = ("topn_src", tuple(comp.signature), len(shards),
+                   rows_tensor.shape[0])
 
             def build():
                 @jax.jit
@@ -935,11 +970,11 @@ class ShardedQueryEngine:
                 return fn
 
             fn = self._fn_build(self._count_fns, sig, build)
-            value = np.asarray(fn(rows_tensor, src_leaves))
+            value = np.asarray(fn(rows_tensor, src_leaves))[:r_real]
             self._aux_store(mkey, fp, value)
             return value[sel]
 
-        sig = ("topn", len(shards), len(row_ids))
+        sig = ("topn", len(shards), rows_tensor.shape[0])
 
         def build():
             @jax.jit
@@ -951,7 +986,7 @@ class ShardedQueryEngine:
             return fn
 
         fn = self._fn_build(self._count_fns, sig, build)
-        value = np.asarray(fn(rows_tensor))
+        value = np.asarray(fn(rows_tensor))[:r_real]
         self._aux_store(mkey, fp, value)
         return value[sel]
 

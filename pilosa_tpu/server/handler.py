@@ -126,6 +126,7 @@ class Handler:
             Route("POST", r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/attr/diff", self.handle_field_attr_diff),
             Route("GET", r"/debug/vars", self.handle_debug_vars),
             Route("POST", r"/debug/profile", self.handle_debug_profile),
+            Route("GET", r"/debug/threads", self.handle_debug_threads),
             Route("GET", r"/internal/diagnostics", self.handle_diagnostics),
         ]
 
@@ -499,6 +500,28 @@ class Handler:
         finally:
             self._profile_lock.release()
         return {"path": out}
+
+    def handle_debug_threads(self, **kw):
+        """Stack dump of every live Python thread — the goroutine-dump half
+        of the reference's /debug/pprof mount (http/handler.go:195). A hung
+        monitor or a stuck device dispatch shows up here without attaching
+        a debugger to the live node."""
+        import sys
+        import traceback
+
+        frames = sys._current_frames()
+        names = {t.ident: t for t in threading.enumerate()}
+        out = {}
+        for ident, frame in frames.items():
+            t = names.get(ident)
+            # The ident keeps duplicate-named threads distinct (multiple
+            # in-process nodes each run a 'query-coalescer' etc.).
+            label = (
+                f"{t.name}-{ident} ({'daemon' if t.daemon else 'thread'})"
+                if t else f"thread-{ident}"
+            )
+            out[label] = traceback.format_stack(frame)
+        return {"threads": out, "count": len(out)}
 
     def handle_diagnostics(self, **kw):
         return self.api.server.diagnostics.gather()

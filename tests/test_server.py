@@ -6,6 +6,7 @@ with static membership and a deterministic ModHasher — the reference's
 trick for distributed tests without containers (test/pilosa.go:161-238).
 """
 
+import os
 import socket
 import time
 
@@ -282,6 +283,28 @@ def test_debug_vars_and_diagnostics(server, client):
     with urllib.request.urlopen(f"http://{host(server)}/internal/diagnostics") as resp:
         diag = json.loads(resp.read())
     assert diag["numIndexes"] >= 1 and diag["version"]
+
+
+def test_debug_threads_and_profile(server):
+    import json
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://{host(server)}/debug/threads") as resp:
+        dump = json.loads(resp.read())
+    assert dump["count"] >= 1
+    # The serving thread's own stack must be present and show the handler.
+    assert any(
+        any("handle_debug_threads" in line for line in stack)
+        for stack in dump["threads"].values()
+    )
+    req = urllib.request.Request(
+        f"http://{host(server)}/debug/profile?seconds=0.1", method="POST"
+    )
+    with urllib.request.urlopen(req) as resp:
+        prof = json.loads(resp.read())
+    assert os.path.isdir(prof["path"])
+    # The capture must have written a trace artifact, not just the dir.
+    assert any(files for _, _, files in os.walk(prof["path"]))
 
 
 def test_long_query_logging(tmp_path):
