@@ -11,21 +11,29 @@ executed with the STRONGEST available host path — the native C kernel
 it loads, else a numpy fallback — measured in this same process. >1.0 means
 the device path is faster.
 
-Backend bring-up is deliberately paranoid (the TPU tunnel can be down):
-the default backend is probed in a subprocess with retries + backoff, every
-probe's outcome (rc, elapsed, stderr tail) is recorded in detail.probes so
-a dead tunnel is distinguishable from broken code, and BENCH_REQUIRE_TPU=1
-exits non-zero instead of silently benchmarking the CPU.
+Backend bring-up is deliberately paranoid (the TPU tunnel can be down, and
+can HANG rather than fail fast): the default backend is probed in a
+subprocess with a timeout; if it's down the bench falls back to CPU
+immediately so results are guaranteed, keeps re-probing in the background
+ACROSS THE WHOLE DEADLINE WINDOW, and re-runs the full suite in a child
+process the moment the tunnel comes up — the child's TPU line is the one
+emitted. Every probe's outcome (rc, elapsed, stderr tail) is recorded in
+detail.probes so a dead tunnel is distinguishable from broken code, and
+BENCH_REQUIRE_TPU=1 keeps probing then exits non-zero instead of silently
+benchmarking the CPU.
 
 Env knobs: BENCH_SHARDS (default 8), BENCH_ROWS (default 128),
 BENCH_DENSITY (default 0.02), BENCH_ITERS (default 1024, capped at
 BENCH_ROWS*(BENCH_ROWS-1) so batches contain no duplicate queries),
-BENCH_PROBE_TIMEOUT (per-attempt seconds, default 150),
-BENCH_PROBE_ATTEMPTS (default 3), BENCH_REQUIRE_TPU=1 (fail instead of
-CPU fallback), BENCH_FORCE_PLATFORM, BENCH_HBM_GIB (resident-stack size
-for the bandwidth stanza; default 8 on TPU / 0.125 on CPU), and
-BENCH_{HBM,SCALE,OPEN,IMPORT,SERVING,TOPN_BSI,TIME_RANGE}=0 to skip a stanza
-(the Pallas-vs-XLA kernel race lives inside the HBM stanza).
+BENCH_PROBE_TIMEOUT (first-probe seconds, default 120),
+BENCH_REQUIRE_TPU=1 (fail instead of CPU fallback), BENCH_FORCE_PLATFORM,
+BENCH_HBM_GIB (resident-stack size for the bandwidth stanza; default 8 on
+TPU / 0.125 on CPU), BENCH_BIG_{SHARDS,ROWS,ITERS} (HBM-resident headline
+stanza; default 256x128 = 4 GiB on TPU / 16x32 on CPU),
+BENCH_CHILD_MIN_S (minimum window worth handing to a TPU child, default
+420), and BENCH_{HBM,BIG,SCALE,OPEN,IMPORT,SERVING,TOPN_BSI,TIME_RANGE}=0
+to skip a stanza (the Pallas-vs-XLA kernel race lives inside the HBM
+stanza).
 """
 
 import json
@@ -84,70 +92,6 @@ def _probe_once(platform, timeout):
         diag["ok"] = False
     diag["elapsed_s"] = round(time.perf_counter() - t0, 1)
     return diag
-
-
-def _ensure_live_backend():
-    """Pick a live backend without ever hanging the bench.
-
-    Returns (platform_label, probes) where probes is the full diagnostic
-    trail. Tries the default backend (the TPU) BENCH_PROBE_ATTEMPTS times
-    with backoff, then an explicit 'tpu' platform once (in case the default
-    was overridden), and only then falls back to CPU — unless
-    BENCH_REQUIRE_TPU=1, in which case it prints the JSON line with the
-    probe trail and exits non-zero so the wrong hardware is never
-    benchmarked silently."""
-    probes = []
-    require_tpu = os.environ.get("BENCH_REQUIRE_TPU") == "1"
-    tpu_platforms = ("tpu", "axon")
-    forced = os.environ.get("BENCH_FORCE_PLATFORM")
-    if forced and not (require_tpu and forced not in tpu_platforms):
-        import jax
-
-        jax.config.update("jax_platforms", forced)
-        return forced, [{"platform": forced, "ok": True, "forced": True}]
-
-    timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
-    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
-    for i in range(attempts):
-        diag = _probe_once(None, timeout)
-        diag["attempt"] = i + 1
-        probes.append(diag)
-        if diag["ok"]:
-            # REQUIRE_TPU must not accept an environment whose default
-            # backend is the CPU: check what the probe actually found.
-            if require_tpu and diag.get("probed_platform") not in tpu_platforms:
-                diag["rejected"] = "default backend is not a TPU"
-            else:
-                return "default", probes
-        time.sleep(min(5 * (i + 1), 15))
-    # The default platform may have been overridden to something dead;
-    # explicitly ask for a 'tpu' platform once. Under axon the TPU platform
-    # is registered as 'axon' so this usually errors fast — the recorded
-    # error proves which platforms exist in the environment.
-    diag = _probe_once("tpu", min(timeout, 60))
-    probes.append(diag)
-    if diag["ok"]:
-        import jax
-
-        jax.config.update("jax_platforms", "tpu")
-        return "tpu", probes
-
-    if require_tpu:
-        print(json.dumps({
-            "metric": "count_intersect_qps_8shards",
-            "value": 0,
-            "unit": "queries/sec",
-            "vs_baseline": 0,
-            "detail": {"error": "BENCH_REQUIRE_TPU=1 and no TPU backend came up",
-                       "probes": probes},
-        }))
-        sys.exit(1)
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    print("bench: default backend unavailable; falling back to CPU "
-          f"(probe trail: {json.dumps(probes)})", file=sys.stderr)
-    return "cpu", probes
 
 
 def _device_info():
@@ -584,6 +528,174 @@ def bench_scale():
     }
 
 
+# ------------------------------------------- HBM-resident headline stanza
+
+
+def bench_big():
+    """HBM-resident, win-by-a-lot headline: a multi-GiB dense index served
+    from device memory — Count(Intersect) batched qps and TopN qps vs the
+    host native-C kernel (and_count_words) on the SAME planes — plus
+    leaf-cache eviction behavior under a halved byte budget at scale.
+
+    Default shape: 256 shards x 128 rows = 4 GiB resident on TPU
+    (BENCH_BIG_SHARDS/BENCH_BIG_ROWS override; 16 x 32 = 256 MiB on CPU
+    so the stanza still validates there). Fragments are built by direct
+    dense-container injection: this stanza measures SERVING at scale —
+    bench_import owns the ingest path, and multi-GiB through bulk_import
+    would measure the host parser, not the chip."""
+    from pilosa_tpu import native
+    from pilosa_tpu.constants import SHARD_WIDTH, WORDS_PER_ROW
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.bitmap import Container
+
+    on_tpu = _on_tpu_platform()
+    n_shards = int(os.environ.get("BENCH_BIG_SHARDS", "256" if on_tpu else "16"))
+    n_rows = int(os.environ.get("BENCH_BIG_ROWS", "128" if on_tpu else "32"))
+    n_containers = SHARD_WIDTH >> 16
+    plane_bytes = n_shards * WORDS_PER_ROW * 4
+    stack_bytes = n_rows * plane_bytes
+    out = {"shards": n_shards, "rows": n_rows,
+           "stack_gib": round(stack_bytes / 2**30, 3)}
+
+    rng = np.random.default_rng(11)
+    holder = Holder(None)
+    holder.open()
+    idx = holder.create_index("big")
+    fld = idx.create_field("f")
+    view = fld.create_view_if_not_exists("standard")
+    t0 = time.perf_counter()
+    for shard in range(n_shards):
+        frag = view.create_fragment_if_not_exists(shard, broadcast=False)
+        words = rng.integers(
+            0, 1 << 64, size=(n_rows, n_containers, 1024), dtype=np.uint64
+        )
+        counts = np.bitwise_count(words).sum(axis=2)
+        for row in range(n_rows):
+            for ci in range(n_containers):
+                frag.storage.containers[row * n_containers + ci] = Container(
+                    bits=words[row, ci], n=int(counts[row, ci])
+                )
+            frag.cache.bulk_add(row, int(counts[row].sum()))
+        frag.cache.invalidate(force=True)
+    out["build_s"] = round(time.perf_counter() - t0, 1)
+
+    # Engine caches must hold the whole stack for the resident phase; the
+    # batched count path and TopN each keep their own stacked copy.
+    budget = str(int(stack_bytes * 1.25))
+    env_keys = ("PILOSA_LEAF_CACHE_BYTES", "PILOSA_STACK_CACHE_BYTES")
+    saved = {k: os.environ.get(k) for k in env_keys}
+    for k in env_keys:
+        os.environ[k] = budget
+    try:
+        ex = Executor(holder, workers=0)
+        engine = ex.engine
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    from pilosa_tpu.pql.parser import parse
+
+    shards = list(range(n_shards))
+
+    # --- Count(Intersect) batched serving on the resident stack.
+    iters = min(int(os.environ.get("BENCH_BIG_ITERS", "256")),
+                n_rows * (n_rows - 1))
+    pairs = _distinct_pairs(n_rows, iters)
+    calls = [
+        parse(f"Count(Intersect(Row(f={a}), Row(f={b})))").calls[0].children[0]
+        for a, b in pairs
+    ]
+    warm = engine.count_batch("big", calls, shards)
+    # Spot-check the exact timed path against host C math on one pair.
+    a, b = pairs[0]
+    want = 0
+    for s in shards:
+        frag = holder.fragment("big", "f", "standard", s)
+        want += int(np.bitwise_count(np.bitwise_and(
+            frag.plane_np(a), frag.plane_np(b))).sum())
+    assert int(warm[0]) == want, f"big count mismatch: {int(warm[0])} != {want}"
+
+    t0 = time.perf_counter()
+    reps = 4
+    for _ in range(reps):
+        np.asarray(engine.count_batch_async("big", calls, shards))
+    dt = time.perf_counter() - t0
+    out["count_qps_device"] = round(reps * iters / dt, 1)
+    out["count_gbs"] = round(reps * iters * 2 * plane_bytes / dt / 1e9, 1)
+
+    # --- Host native-C baseline on the same planes (pre-coerced once).
+    # Few pairs: the ~2s timed loop touches a handful, and every
+    # pre-coerced row costs plane_bytes of extra host RSS (32 MiB at the
+    # 256-shard default — 64 rows would double the container store).
+    lib = native.load()
+    host_planes = {}
+    for row in {r for p in pairs[:8] for r in p}:
+        host_planes[row] = [
+            np.ascontiguousarray(
+                holder.fragment("big", "f", "standard", s).plane_np(row),
+                dtype=np.uint32)
+            for s in shards
+        ]
+    host_pairs = [p for p in pairs[:8] if p[0] in host_planes and p[1] in host_planes]
+
+    def host_once(i):
+        pa, pb = host_planes[host_pairs[i][0]], host_planes[host_pairs[i][1]]
+        if lib is not None:
+            return sum(native.and_count_words(x, y) for x, y in zip(pa, pb))
+        return sum(int(np.bitwise_count(np.bitwise_and(x, y)).sum())
+                   for x, y in zip(pa, pb))
+
+    done = 0
+    t0 = time.perf_counter()
+    while done < 3 or time.perf_counter() - t0 < 2.0:
+        host_once(done % len(host_pairs))
+        done += 1
+    host_qps = done / (time.perf_counter() - t0)
+    out["count_qps_host"] = round(host_qps, 2)
+    out["host_method"] = "native_c" if lib is not None else "numpy"
+    out["count_vs_host"] = round(out["count_qps_device"] / max(host_qps, 1e-9), 1)
+
+    # --- TopN at scale (full candidate set rides the resident stack).
+    cyc = {"i": 0}
+
+    def next_topn():
+        cyc["i"] += 1
+        return ex.execute("big", f"TopN(f, Row(f={cyc['i'] % n_rows}), n=10)")
+
+    next_topn()  # compile + stack build
+    t0 = time.perf_counter()
+    reps = 6
+    for _ in range(reps):
+        next_topn()
+    out["topn_qps_device"] = round(reps / (time.perf_counter() - t0), 2)
+
+    # --- Eviction under pressure: budget halved, sweep every row once.
+    for k in env_keys:
+        os.environ[k] = str(int(stack_bytes * 0.5))
+    try:
+        from pilosa_tpu.parallel.engine import ShardedQueryEngine
+
+        tight = ShardedQueryEngine(holder)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    row_calls = [parse(f"Row(f={r})").calls[0] for r in range(n_rows)]
+    t0 = time.perf_counter()
+    for call in row_calls:
+        tight.count("big", call, shards)
+    out["evict_sweep_ms_per_query"] = round(
+        (time.perf_counter() - t0) / n_rows * 1e3, 2)
+    out["evictions"] = tight.counters["leaf_evictions"]
+    holder.close()
+    return out
+
+
 # ----------------------------------------------- concurrent-serving stanza
 
 
@@ -999,6 +1111,18 @@ def bench_open():
     }
 
 
+def _last_json_line(text):
+    """Last parseable JSON object line in `text` (a child bench's stdout)."""
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except Exception:
+                continue
+    return None
+
+
 def main():
     # Deadline watchdog: the tunnel can die MID-stanza, leaving a blocked
     # device call that never returns — the driver would record no bench
@@ -1006,6 +1130,7 @@ def main():
     # prints the JSON line with everything collected so far and exits.
     import threading
 
+    t_start = time.time()
     deadline = float(os.environ.get("BENCH_DEADLINE", "2400"))
     partial = {
         "metric": "count_intersect_qps_8shards",
@@ -1039,7 +1164,114 @@ def main():
     # while still counting them at full weight.
     iters = min(int(os.environ.get("BENCH_ITERS", "1024")), n_rows * (n_rows - 1))
 
-    platform, probes = _ensure_live_backend()
+    # ---- backend bring-up: probe attempts SPREAD across the whole bench
+    # window (r04 burned all 3 attempts in the first minutes of a 40-min
+    # deadline and recorded a CPU-only round). One quick probe up front;
+    # if the tunnel is down, fall back to CPU immediately so results are
+    # guaranteed, keep re-probing in the BACKGROUND, and when the tunnel
+    # comes up re-run the whole suite there in a child process whose JSON
+    # line (platform: tpu) is the one emitted.
+    is_child = os.environ.get("BENCH_CHILD") == "1"
+    require_tpu = os.environ.get("BENCH_REQUIRE_TPU") == "1"
+    forced = os.environ.get("BENCH_FORCE_PLATFORM")
+    tpu_platforms = ("tpu", "axon")
+    probes = []
+    platform = None
+    tpu_up = threading.Event()
+    stop_prober = threading.Event()
+    # Set when a TPU answered only on an EXPLICIT platform name (the
+    # default-platform override is dead): the child run gets pinned to it.
+    tpu_platform_arg = {"explicit": None}
+
+    def probe_round(n, timeout):
+        """One spread-probe attempt: the default platform, then — every
+        other round — the explicit 'tpu'/'axon' names, recovering from a
+        dead default-platform override (the old bring-up probed 'tpu'
+        explicitly once; keep that capability in the spread design).
+        Returns True when a TPU answered."""
+        diag = _probe_once(None, timeout)
+        diag["attempt"] = n
+        probes.append(diag)
+        if diag.get("ok") and diag.get("probed_platform") in tpu_platforms:
+            return True
+        if n % 2 == 0:
+            for explicit in tpu_platforms:
+                d2 = _probe_once(explicit, min(timeout, 60))
+                d2["attempt"] = n
+                probes.append(d2)
+                if d2.get("ok"):
+                    tpu_platform_arg["explicit"] = explicit
+                    return True
+        return False
+
+    if forced and not (require_tpu and forced not in tpu_platforms):
+        import jax
+
+        jax.config.update("jax_platforms", forced)
+        platform = forced
+        probes.append({"platform": forced, "ok": True, "forced": True})
+    else:
+        quick = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+        diag = _probe_once(None, quick)
+        diag["attempt"] = 1
+        probes.append(diag)
+        if diag["ok"]:
+            if require_tpu and diag.get("probed_platform") not in tpu_platforms:
+                diag["rejected"] = "default backend is not a TPU"
+            else:
+                platform = "default"
+
+    if platform is None and require_tpu:
+        # No CPU fallback allowed: probe inline across the window, then
+        # fail with the full trail.
+        per = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+        n = 1
+        while time.time() - t_start < deadline - per - 120:
+            time.sleep(60)
+            n += 1
+            if probe_round(n, per):
+                platform = "default"
+                if tpu_platform_arg["explicit"]:
+                    import jax
+
+                    jax.config.update(
+                        "jax_platforms", tpu_platform_arg["explicit"])
+                    platform = tpu_platform_arg["explicit"]
+                break
+        if platform is None:
+            print(json.dumps({
+                "metric": "count_intersect_qps_8shards",
+                "value": 0,
+                "unit": "queries/sec",
+                "vs_baseline": 0,
+                "detail": {
+                    "error": "BENCH_REQUIRE_TPU=1 and no TPU backend came up",
+                    "probes": probes,
+                },
+            }))
+            sys.exit(1)
+    elif platform is None:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+        print("bench: default backend unavailable; benchmarking CPU now and "
+              "re-probing the tunnel in the background", file=sys.stderr)
+        if not is_child:
+            def prober():
+                n = 1
+                while not stop_prober.wait(90):
+                    n += 1
+                    mark = len(probes)
+                    hit = probe_round(n, 60)
+                    for d in probes[mark:]:
+                        d["background"] = True
+                    if hit:
+                        tpu_up.set()
+                        return
+
+            threading.Thread(target=prober, daemon=True).start()
+
     device = _device_info()
     partial["detail"]["device"] = device
     partial["detail"]["probes"] = probes
@@ -1063,6 +1295,7 @@ def main():
         return out
 
     hbm = stanza("HBM", bench_hbm)
+    big = stanza("BIG", bench_big)
     scale = stanza("SCALE", bench_scale)
     open_stanza = stanza("OPEN", bench_open)
     import_stanza = stanza("IMPORT", bench_import)
@@ -1081,7 +1314,75 @@ def main():
     else:
         pallas = {"note": "kernel validation needs a TPU; see detail.hbm"}
 
+    # ---- TPU handoff: if this run fell back to CPU and the background
+    # prober found the tunnel alive (now or within the remaining window),
+    # re-run the entire suite there in a child process and emit ITS line —
+    # a TPU-validated BENCH beats a CPU one every time. The child gets the
+    # remaining deadline (its own watchdog emits partials if the tunnel
+    # dies again); on any child failure — nonzero exit, watchdog partial,
+    # unparseable output — the CPU line below still prints, with the
+    # failure recorded in it.
+    child_error = None
+    if platform == "cpu" and not is_child:
+        min_child = float(os.environ.get("BENCH_CHILD_MIN_S", "420"))
+        while not tpu_up.is_set():
+            left = deadline - (time.time() - t_start)
+            if left < min_child + 150:
+                break
+            if tpu_up.wait(timeout=min(30, left)):
+                break
+        stop_prober.set()
+        left = deadline - (time.time() - t_start) - 90
+        if tpu_up.is_set() and left > min_child:
+            env = dict(os.environ)
+            env["BENCH_CHILD"] = "1"
+            env["BENCH_DEADLINE"] = str(int(left - 30))
+            env.setdefault("BENCH_PROBE_TIMEOUT", "120")
+            if tpu_platform_arg["explicit"]:
+                # The tunnel answered only the explicit 'tpu' platform (the
+                # default platform override is dead): pin the child to it.
+                env["BENCH_FORCE_PLATFORM"] = tpu_platform_arg["explicit"]
+            child = None
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env=env, capture_output=True, text=True, timeout=left,
+                )
+                child = _last_json_line(r.stdout)
+                if child is None:
+                    child_error = (f"child rc={r.returncode}, no JSON line; "
+                                   f"stderr tail: {r.stderr[-300:]}")
+                elif r.returncode != 0 or not isinstance(
+                        child.get("detail"), dict):
+                    child_error = (f"child rc={r.returncode}; its line was "
+                                   "partial/invalid and is recorded, not "
+                                   "emitted")
+                    partial["detail"]["tpu_child_partial"] = child
+                    child = None
+                elif child["detail"].get("partial") or \
+                        child["detail"].get("error"):
+                    child_error = "child watchdog fired; partial recorded"
+                    partial["detail"]["tpu_child_partial"] = child
+                    child = None
+            except Exception as e:
+                child_error = f"{type(e).__name__}: {e}"[:300]
+            if child is not None:
+                child["detail"]["cpu_fallback_run"] = {
+                    "count_qps": round(count_qps, 2),
+                    "vs_host": round(count_qps / host_qps, 3),
+                }
+                child["detail"]["parent_probes"] = probes
+                state["done"] = True
+                print(json.dumps(child))
+                return
+    stop_prober.set()
+
     state["done"] = True
+    extra = {}
+    if child_error is not None:
+        extra["tpu_child_error"] = child_error
+        if "tpu_child_partial" in partial["detail"]:
+            extra["tpu_child_partial"] = partial["detail"]["tpu_child_partial"]
     print(json.dumps({
         "metric": "count_intersect_qps_8shards",
         "value": round(count_qps, 2),
@@ -1099,6 +1400,7 @@ def main():
             "device": device,
             "probes": probes,
             "hbm": hbm,
+            "big": big,
             "pallas": pallas,
             "scale": scale,
             "open": open_stanza,
@@ -1106,6 +1408,7 @@ def main():
             "serving": serving,
             "topn_bsi": topn_bsi,
             "time_range": time_range,
+            **extra,
         },
     }))
 

@@ -34,6 +34,21 @@ from .timeq import parse_timestamp, views_by_time_range
 DEFAULT_FIELD = "general"
 DEFAULT_MIN_THRESHOLD = 1
 
+
+def _topn_chunk(n_shards: int) -> int:
+    """Candidate rows per TopN device program, bounded by BYTES not rows:
+    a fixed 512-row chunk is 256 MiB at 8 shards but 16 GiB at 256 shards
+    (each row costs n_shards * 128 KiB in the stacked tensor). The byte
+    budget (PILOSA_TOPN_CHUNK_BYTES, default 2 GiB) trades dispatches per
+    TopN against stacked-tensor working set; row counts pad to pow2 in the
+    engine so varied chunk sizes reuse compiled programs."""
+    import os
+
+    from .constants import WORDS_PER_ROW
+
+    budget = int(os.environ.get("PILOSA_TOPN_CHUNK_BYTES", 2 << 30))
+    return max(16, min(512, budget // max(1, n_shards * WORDS_PER_ROW * 4)))
+
 _WRITE_CALLS = {"Set", "Clear", "SetValue", "SetRowAttrs", "SetColumnAttrs"}
 
 
@@ -688,7 +703,7 @@ class Executor:
             field_name = c.args.get("_field") or DEFAULT_FIELD
             try:
                 pairs: List[Pair] = []
-                CHUNK = 512  # bounds the (R, S, W) global stack
+                CHUNK = _topn_chunk(len(shards))  # bounds the (R, S, W) global stack
                 for i in range(0, len(ids), CHUNK):
                     chunk = ids[i : i + CHUNK]
                     counts = self.collective.topn_counts(
@@ -812,7 +827,7 @@ class Executor:
                     s: {} for s in shard_list
                 }
                 src_count_by_shard: Dict[int, int] = {}
-                CHUNK = 512  # bounds the (R, S, W) gather working set
+                CHUNK = _topn_chunk(len(shard_list))  # bounds the gather working set
                 for i in range(0, len(union), CHUNK):
                     chunk = union[i : i + CHUNK]
                     # Ranking uses the cache counts already attached to the

@@ -324,8 +324,23 @@ class InternalClient:
         self._request("POST", url, data, "application/octet-stream")
 
     def send_message(self, node, msg: dict) -> None:
-        body = json.dumps(msg).encode()
-        self._request("POST", f"{_node_url(node)}/internal/cluster/message", body)
+        """Cluster envelope POST (reference http/client.go SendMessage).
+
+        Default wire format is the reference's type-byte + protobuf
+        envelope (broadcast.go:52-162, proto/envelope.py); repo-native
+        message types ride a JSON extension frame inside it.
+        PILOSA_TPU_CLUSTER_JSON=1 forces plain JSON (the debug fallback
+        the handler always accepts)."""
+        import os
+
+        if os.environ.get("PILOSA_TPU_CLUSTER_JSON") == "1":
+            body, ctype = json.dumps(msg).encode(), "application/json"
+        else:
+            from .proto import envelope
+
+            body, ctype = envelope.encode_message(msg), "application/x-protobuf"
+        self._request("POST", f"{_node_url(node)}/internal/cluster/message",
+                      body, ctype)
 
     def translate_data(self, node, offset: int) -> bytes:
         url = f"{_node_url(node)}/internal/translate/data?offset={offset}"

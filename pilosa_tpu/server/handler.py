@@ -378,8 +378,17 @@ class Handler:
         self.api.set_coordinator(req.get("id", ""))
         return {}
 
-    def handle_cluster_message(self, body, **kw):
-        self.api.cluster_message(_json_body(body))
+    def handle_cluster_message(self, body, headers=None, **kw):
+        """Cluster envelope receive: protobuf type-byte envelope on
+        Content-Type: application/x-protobuf (the reference's only wire
+        format, broadcast.go:116-162), JSON otherwise (debug fallback)."""
+        ctype = (headers or {}).get("content-type", "")
+        if "protobuf" in ctype:
+            from .proto import envelope
+
+            self.api.cluster_message(envelope.decode_message(body))
+        else:
+            self.api.cluster_message(_json_body(body))
         return {}
 
     def handle_collective_count(self, body, **kw):

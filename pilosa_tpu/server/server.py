@@ -717,6 +717,15 @@ class Server:
             self.handle_node_join(Node.from_dict(msg["node"]))
         elif typ == "node-leave":
             self.handle_node_leave(msg["nodeID"])
+        elif typ == "node-update":
+            # Metadata refresh (reference nodeUpdate, event.go:23):
+            # never a membership change.
+            upd = Node.from_dict(msg["node"])
+            existing = self.cluster.node_by_id(upd.id)
+            if existing is not None:
+                existing.uri = upd.uri or existing.uri
+                if upd.process_idx is not None:
+                    existing.process_idx = upd.process_idx
         elif typ == "collective-exec":
             # Non-leader side of leader-driven collective serving: enqueue
             # the descriptor for the runner thread (SPMD entry happens in
