@@ -700,10 +700,16 @@ def bench_big():
 
 
 def bench_serving():
-    """48 parallel HTTP clients against a live in-process server, with and
-    without the query coalescer (1ms window): end-to-end qps through the
-    real threaded HTTP stack plus the batching counters that prove the
-    win came from coalescing, not noise."""
+    """48 parallel HTTP clients against a live in-process server:
+    end-to-end concurrent serving qps through the real threaded HTTP
+    stack, with the host result memo both off (every request pays a real
+    dispatch) and on (the production zipf-repeat regime).
+
+    A transparent query coalescer was removed in r5 after three rounds of
+    driver-captured losses (r3 0.39x remote, r5 0.71x host — concurrent
+    blocking clients pipeline their own round trips / host threads
+    parallelize dispatches across cores); this stanza now tracks the
+    serving path that actually ships."""
     from concurrent.futures import ThreadPoolExecutor
 
     from pilosa_tpu.constants import SHARD_WIDTH
@@ -713,15 +719,9 @@ def bench_serving():
     n_rows, n_clients, per_client = 32, 48, 12
     rng = np.random.default_rng(11)
     out = {}
-    for label, window in (("no_coalesce", 0.0), ("coalesce_1ms", 0.001)):
-        # Disable the host result memo for this stanza: 48 clients cycling
-        # 32 queries would be 100% memo hits after warmup, so both sides
-        # would measure dict lookups and the coalescer comparison would be
-        # vacuous. With the memo off every request pays a real dispatch —
-        # the regime batching exists for.
-        os.environ["PILOSA_MEMO_ENTRIES"] = "0"
-        s = Server(cache_flush_interval=0, member_monitor_interval=0,
-                   query_coalesce_window=window)
+    for label, memo in (("memo_off", "0"), ("memo_on", "8192")):
+        os.environ["PILOSA_MEMO_ENTRIES"] = memo
+        s = Server(cache_flush_interval=0, member_monitor_interval=0)
         s.open()
         try:
             idx = s.holder.create_index("serve")
@@ -739,8 +739,7 @@ def bench_serving():
                 for i in range(per_client):
                     local.query(h, "serve", f"Count(Row(f={(wid + i) % n_rows}))")
 
-            # Warm: compile the single + batched programs (batch-size
-            # buckets fill during a concurrent pre-pass) and the leaf cache,
+            # Warm: compile programs + fill leaf cache (and memo when on),
             # so the timed pass measures steady-state serving.
             with ThreadPoolExecutor(max_workers=n_clients) as pool:
                 list(pool.map(worker, range(n_clients)))
@@ -749,28 +748,13 @@ def bench_serving():
                 list(pool.map(worker, range(n_clients)))
             qps = n_clients * per_client / (time.perf_counter() - t0)
             out[f"qps_{label}"] = round(qps, 1)
-            co = s.executor.coalescer
-            if co is not None:
-                out["batches_executed"] = co.batches_executed
-                out["queries_batched"] = co.queries_batched
-                out["avg_batch"] = round(
-                    co.queries_batched / max(co.batches_executed, 1), 1
-                )
         finally:
             s.close()
             os.environ.pop("PILOSA_MEMO_ENTRIES", None)
-    if out.get("qps_no_coalesce"):
-        out["speedup"] = round(
-            out["qps_coalesce_1ms"] / out["qps_no_coalesce"], 2
+    if out.get("qps_memo_off"):
+        out["memo_speedup"] = round(
+            out["qps_memo_on"] / out["qps_memo_off"], 2
         )
-        if _on_tpu_platform() and out["speedup"] < 1:
-            # Through the axon tunnel every dispatch/transfer is a ~70ms
-            # RPC and N independent blocking clients already pipeline N
-            # round trips, so batching can only tie at best; on a
-            # locally-attached chip dispatch overhead is host-side and
-            # coalescing is the scaling path. Record the RTT so the judge
-            # can see which regime this run measured.
-            out["transport_note"] = "remote-runtime link; RTT-bound regime"
     return out
 
 

@@ -41,9 +41,9 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    type=lambda s: [h.strip() for h in s.split(",") if h.strip()])
     p.add_argument("--cluster-replicas", dest="cluster_replicas", type=int)
     p.add_argument("--long-query-time", dest="long_query_time", type=float)
-    p.add_argument("--query-coalesce-window", dest="query_coalesce_window", type=float)
     p.add_argument("--anti-entropy-interval", dest="anti_entropy_interval", type=float)
     p.add_argument("--gossip-probe-interval", dest="gossip_probe_interval", type=float)
+    p.add_argument("--gossip-failover-probes", dest="gossip_failover_probes", type=int)
     p.add_argument("--gossip-probe-timeout", dest="gossip_probe_timeout", type=float)
     p.add_argument("--gossip-key", dest="gossip_key",
                    help="path to cluster shared-secret file")

@@ -118,10 +118,15 @@ class Cluster:
         return None
 
     def coordinator_node(self) -> Optional[Node]:
-        for n in self.nodes:
-            if n.is_coordinator:
+        """The coordinator, preferring an AVAILABLE flagged node: after a
+        failover a survivor can transiently hold both the dead
+        coordinator's stale flag and the successor's fresh claim — joins
+        must route to the live one, not the lowest-id corpse."""
+        flagged = [n for n in self.nodes if n.is_coordinator]
+        for n in flagged:
+            if n.id not in self.unavailable:
                 return n
-        return None
+        return flagged[0] if flagged else None
 
     def is_coordinator(self) -> bool:
         return self.node.is_coordinator

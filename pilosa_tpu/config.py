@@ -44,6 +44,11 @@ class GossipConfig:
 
     probe_interval: float = 2.0  # seconds between member heartbeat rounds
     probe_timeout: float = 2.0  # per-probe HTTP deadline (seconds)
+    # Consecutive failed coordinator heartbeats before the deterministic
+    # successor (lowest alive node id, majority required) self-promotes;
+    # 0 disables automatic failover (reference behavior: manual
+    # set-coordinator only, api.go:777).
+    failover_probes: int = 3
     key: str = ""  # path to shared-secret file; empty = open cluster
 
 
@@ -80,10 +85,6 @@ class Config:
     bind: str = "localhost:10101"
     max_writes_per_request: int = 5000
     verbose: bool = False
-    # TPU-first serving: micro-batch window (seconds) for coalescing
-    # concurrent fast-path Count queries into one device program
-    # (parallel/coalescer.py). 0 disables.
-    query_coalesce_window: float = 0.0
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     gossip: GossipConfig = field(default_factory=GossipConfig)
@@ -112,9 +113,6 @@ class Config:
             "max-writes-per-request", self.max_writes_per_request
         )
         self.verbose = d.get("verbose", self.verbose)
-        self.query_coalesce_window = d.get(
-            "query-coalesce-window", self.query_coalesce_window
-        )
         c = d.get("cluster", {})
         self.cluster.disabled = c.get("disabled", self.cluster.disabled)
         self.cluster.coordinator = c.get("coordinator", self.cluster.coordinator)
@@ -126,6 +124,7 @@ class Config:
         g = d.get("gossip", {})
         self.gossip.probe_interval = g.get("probe-interval", self.gossip.probe_interval)
         self.gossip.probe_timeout = g.get("probe-timeout", self.gossip.probe_timeout)
+        self.gossip.failover_probes = g.get("failover-probes", self.gossip.failover_probes)
         self.gossip.key = g.get("key", self.gossip.key)
         m = d.get("metric", {})
         self.metric.service = m.get("service", self.metric.service)
@@ -157,7 +156,6 @@ class Config:
             ("bind", "BIND", str),
             ("max_writes_per_request", "MAX_WRITES_PER_REQUEST", int),
             ("verbose", "VERBOSE", bool),
-            ("query_coalesce_window", "QUERY_COALESCE_WINDOW", float),
         ]:
             v = env(name, cast)
             if v is not None:
@@ -178,6 +176,7 @@ class Config:
         for attr, name, cast in [
             ("probe_interval", "GOSSIP_PROBE_INTERVAL", float),
             ("probe_timeout", "GOSSIP_PROBE_TIMEOUT", float),
+            ("failover_probes", "GOSSIP_FAILOVER_PROBES", int),
             ("key", "GOSSIP_KEY", str),
         ]:
             v = env(name, cast)
@@ -209,10 +208,10 @@ class Config:
             "cluster_coordinator": ("cluster", "coordinator"),
             "cluster_disabled": ("cluster", "disabled"),
             "long_query_time": ("cluster", "long_query_time"),
-            "query_coalesce_window": ("query_coalesce_window",),
             "anti_entropy_interval": ("anti_entropy", "interval"),
             "gossip_probe_interval": ("gossip", "probe_interval"),
             "gossip_probe_timeout": ("gossip", "probe_timeout"),
+            "gossip_failover_probes": ("gossip", "failover_probes"),
             "gossip_key": ("gossip", "key"),
             "translation_primary_url": ("translation", "primary_url"),
             "tls_certificate": ("tls", "certificate_path"),
@@ -246,7 +245,6 @@ class Config:
             f"bind = {fmt(self.bind)}",
             f"max-writes-per-request = {self.max_writes_per_request}",
             f"verbose = {fmt(self.verbose)}",
-            f"query-coalesce-window = {self.query_coalesce_window}",
             "",
             "[cluster]",
             f"disabled = {fmt(self.cluster.disabled)}",
@@ -261,6 +259,7 @@ class Config:
             "[gossip]",
             f"probe-interval = {self.gossip.probe_interval}",
             f"probe-timeout = {self.gossip.probe_timeout}",
+            f"failover-probes = {self.gossip.failover_probes}",
             f"key = {fmt(self.gossip.key)}",
             "",
             "[metric]",
@@ -310,9 +309,9 @@ class Config:
             metric_poll_interval=self.metric.poll_interval,
             primary_translate_store_url=self.translation.primary_url or None,
             max_writes_per_request=self.max_writes_per_request,
-            query_coalesce_window=self.query_coalesce_window,
             member_monitor_interval=self.gossip.probe_interval,
             member_probe_timeout=self.gossip.probe_timeout,
+            coordinator_failover_probes=self.gossip.failover_probes,
             internal_key_path=self.gossip.key or None,
         )
         kw.update(overrides)

@@ -9,7 +9,6 @@ dispatch, the shard map/reduce (executor.go:1464-1593), two-phase TopN
 
 from __future__ import annotations
 
-import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
@@ -102,7 +101,6 @@ class Executor:
         translate_store=None,
         max_writes_per_request: int = MAX_WRITES_PER_REQUEST,
         workers: int = 8,
-        coalesce_window: float = 0.0,
     ):
         from .cluster.node import Cluster
 
@@ -113,9 +111,6 @@ class Executor:
         self.max_writes_per_request = max_writes_per_request
         self._pool = ThreadPoolExecutor(max_workers=workers) if workers > 1 else None
         self._engine = None  # lazy ShardedQueryEngine
-        self.coalesce_window = coalesce_window
-        self._coalescer = None  # lazy QueryCoalescer (when window > 0)
-        self._coalescer_init_lock = threading.Lock()
         # Multi-host collective backend (parallel/collective.py), wired by
         # the server. When a jax.distributed job spans the cluster, full-
         # index fast-path queries run as ONE SPMD program over the global
@@ -133,25 +128,8 @@ class Executor:
             self._engine = ShardedQueryEngine(self.holder)
         return self._engine
 
-    @property
-    def coalescer(self):
-        if self.coalesce_window <= 0:
-            return None
-        if self._coalescer is None:
-            with self._coalescer_init_lock:
-                if self._coalescer is None:  # double-checked: one instance
-                    from .parallel.coalescer import QueryCoalescer
-
-                    self._coalescer = QueryCoalescer(
-                        self.engine, window=self.coalesce_window
-                    )
-        return self._coalescer
-
     def close(self) -> None:
-        """Release serving resources (coalescer worker, thread pool)."""
-        if self._coalescer is not None:
-            self._coalescer.close()
-            self._coalescer = None
+        """Release serving resources (thread pool)."""
         if self._pool is not None:
             self._pool.shutdown(wait=False)
 
@@ -532,9 +510,6 @@ class Executor:
 
             def local_runner(local_shards):
                 if kind == "count":
-                    co = self.coalescer
-                    if co is not None:
-                        return co.count(index, target, local_shards)
                     return self.engine.count(
                         index, target, local_shards, comp_expr=compiled)
                 return self.engine.bitmap(

@@ -237,10 +237,10 @@ class ShardedQueryEngine:
         # shapes would otherwise accumulate them without bound.
         self._fn_budget = int(os.environ.get("PILOSA_FN_CACHE_ENTRIES", 256))
         self._building: Dict[Tuple, threading.Event] = {}
-        # The server handles requests on ThreadingHTTPServer threads plus the
-        # coalescer worker, so every cache (LRU touch included) mutates under
-        # concurrency. One lock guards dict + byte-counter state; device work
-        # (gather, device_put, jit) happens outside it.
+        # The server handles requests on ThreadingHTTPServer threads, so
+        # every cache (LRU touch included) mutates under concurrency. One
+        # lock guards dict + byte-counter state; device work (gather,
+        # device_put, jit) happens outside it.
         self._lock = threading.RLock()
         # Host-side hot-query result memo: (index, structure signature,
         # leaves, shards) -> (generation fingerprint, count). A repeat query
@@ -267,8 +267,8 @@ class ShardedQueryEngine:
     # ------------------------------------------------------------ caches
     #
     # All device caches (compiled programs, leaf planes, stacked tensors)
-    # are mutated from ThreadingHTTPServer threads plus the coalescer
-    # worker. `self._lock` guards dict + byte-counter state; `_gate` /
+    # are mutated from concurrent ThreadingHTTPServer threads. `self._lock`
+    # guards dict + byte-counter state; `_gate` /
     # `_release` dedupe expensive cold builds (XLA trace/compile, host
     # gathers, device_put) so N concurrent misses on a key do the work
     # once instead of N times (compile stampede).
@@ -548,8 +548,8 @@ class ShardedQueryEngine:
         """Like count() but returns the unmaterialized device scalar, so
         callers can pipeline many queries before blocking (dispatch latency
         through the host<->device link dominates single-query serving).
-        `comp_expr` lets callers that already compiled the call (e.g. the
-        coalescer, for grouping) skip the second AST walk."""
+        `comp_expr` lets callers that already compiled the call skip the
+        second AST walk."""
         shards = tuple(shards)
         comp, expr = comp_expr if comp_expr is not None else self._compile(index, call)
         sig = ("count", tuple(comp.signature), len(shards))

@@ -524,7 +524,7 @@ class Handler:
         for ident, frame in frames.items():
             t = names.get(ident)
             # The ident keeps duplicate-named threads distinct (multiple
-            # in-process nodes each run a 'query-coalescer' etc.).
+            # in-process nodes each run a 'collective-runner' etc.).
             label = (
                 f"{t.name}-{ident} ({'daemon' if t.daemon else 'thread'})"
                 if t else f"thread-{ident}"

@@ -51,11 +51,11 @@ class Server:
         primary_translate_store_url: Optional[str] = None,
         max_writes_per_request: int = 5000,
         executor_workers: int = 8,
-        query_coalesce_window: float = 0.0,
         diagnostics_interval: float = 0.0,
         diagnostics_endpoint: str = "",
         member_monitor_interval: float = 2.0,
         member_probe_timeout: float = 2.0,
+        coordinator_failover_probes: int = 3,
         internal_key_path: Optional[str] = None,
         join_addr: Optional[str] = None,
         allowed_origins: Optional[List[str]] = None,
@@ -85,6 +85,9 @@ class Server:
         self.anti_entropy_interval = anti_entropy_interval
         self.cache_flush_interval = cache_flush_interval
         self.member_monitor_interval = member_monitor_interval
+        self.coordinator_failover_probes = coordinator_failover_probes
+        # node id -> consecutive failed heartbeat probes (feeds failover).
+        self._probe_failures: dict = {}
         self.metric_poll_interval = metric_poll_interval
         self.primary_translate_store_url = primary_translate_store_url
 
@@ -136,7 +139,6 @@ class Server:
             translate_store=self.translate_store,
             max_writes_per_request=max_writes_per_request,
             workers=executor_workers,
-            coalesce_window=query_coalesce_window,
         )
         self.api = API(self)
         self.handler = Handler(
@@ -250,6 +252,21 @@ class Server:
                     peer = normalize(host)
                     self.cluster.add_node(Node(id=peer, uri=peer))
             self.cluster.nodes.sort(key=lambda n: n.id)
+            # Re-apply persisted coordinator flags: a runtime promotion
+            # (coordinator failover) must survive restart — the config only
+            # knows the ORIGINAL role, so a promoted successor restarting
+            # on config alone would silently drop the claim and leave the
+            # cluster with zero coordinators. Only when the checkpoint
+            # covers this node (else it describes some other membership);
+            # an operator overrides with set-coordinator or by removing
+            # the .topology file.
+            saved_flags = {n.id: n.is_coordinator for n in self.topology.nodes}
+            if saved_flags.get(self.node.id) is not None and any(
+                saved_flags.values()
+            ):
+                for n in self.cluster.nodes:
+                    if n.id in saved_flags:
+                        n.is_coordinator = saved_flags[n.id]
 
         self.holder.open()
         if self._needs_topology_quorum():
@@ -555,10 +572,16 @@ class Server:
                 if node.id not in self.cluster.unavailable:
                     self.logger.info("node %s marked unavailable", node.id)
                 self.cluster.mark_unavailable(node.id)
+                self._probe_failures[node.id] = \
+                    self._probe_failures.get(node.id, 0) + 1
+                if node.is_coordinator:
+                    self._consider_coordinator_failover(node)
             else:
+                self._probe_failures[node.id] = 0
                 if node.id in self.cluster.unavailable:
                     self.logger.info("node %s recovered", node.id)
                 self.cluster.mark_available(node.id)
+                self._reconcile_dual_coordinator(node, status)
                 # Merge the peer's NodeStatus (gossip push/pull sync,
                 # gossip/gossip.go:240-273): schema first — a node that was
                 # down during a create-field broadcast converges here — then
@@ -582,6 +605,46 @@ class Server:
                 # it); the collective plane needs every node's index.
                 if status.get("processIdx") is not None:
                     node.process_idx = status["processIdx"]
+                # Learn the peer's own coordinator claim the same way: a
+                # static config only sets the LOCAL node's flag, so without
+                # this merge a non-coordinator node never knows which peer
+                # to forward joins to — and cannot detect the coordinator's
+                # death for failover. Conflicting claims are settled by
+                # _reconcile_dual_coordinator (lowest id wins).
+                node.is_coordinator = any(
+                    n.get("id") == node.id and n.get("isCoordinator")
+                    for n in status.get("nodes", [])
+                )
+                if node.is_coordinator:
+                    # An ALIVE self-claimer supersedes a dead flagged
+                    # holdover (a survivor that missed the failover
+                    # broadcast would otherwise route joins to the corpse
+                    # forever — no probe of the dead node can ever clear
+                    # its flag).
+                    for other in self.cluster.nodes:
+                        if (
+                            other.id != node.id
+                            and other.is_coordinator
+                            and other.id in self.cluster.unavailable
+                        ):
+                            other.is_coordinator = False
+                elif (
+                    not self.node.is_coordinator
+                    and self.cluster.coordinator_node() is None
+                ):
+                    # We know of NO coordinator (e.g. this node started
+                    # after the coordinator died): adopt the peer's view of
+                    # who holds the role — without this, a late-starting
+                    # successor can never learn whose death to detect.
+                    claimed = next(
+                        (x for x in status.get("nodes", [])
+                         if x.get("isCoordinator")),
+                        None,
+                    )
+                    if claimed is not None:
+                        tgt = self.cluster.node_by_id(claimed.get("id"))
+                        if tgt is not None:
+                            tgt.is_coordinator = True
                 # A probed peer reporting STARTING without us in its node
                 # list is a restarted coordinator waiting on topology
                 # quorum: re-send node-join so it can count us (the
@@ -596,6 +659,88 @@ class Server:
                         )
                     except ClientError:
                         pass
+
+    def _consider_coordinator_failover(self, dead: Node) -> None:
+        """Converge on a deterministic successor when the coordinator dies
+        (the reference requires a manual SetCoordinator, api.go:777, and
+        its joins/resizes block until one arrives — considerTopology,
+        cluster.go:1582-1613). Rules:
+          - only after coordinator_failover_probes CONSECUTIVE failed
+            heartbeats (one blip must not depose a healthy coordinator);
+          - only the successor (lowest node id among members not marked
+            unavailable) promotes itself — everyone else keeps probing and
+            learns the outcome from its set-coordinator broadcast;
+          - only with a strict majority of the membership alive, so a
+            partitioned minority can never elect a second coordinator."""
+        if self.coordinator_failover_probes <= 0:
+            return
+        if self._probe_failures.get(dead.id, 0) < self.coordinator_failover_probes:
+            return
+        alive = [
+            n for n in self.cluster.nodes
+            if n.id not in self.cluster.unavailable
+        ]
+        if 2 * len(alive) <= len(self.cluster.nodes):
+            return  # no strict majority: could be our own partition
+        successor = min(alive, key=lambda n: n.id)
+        if successor.id != self.node.id:
+            return
+        self.logger.info(
+            "coordinator %s failed %d consecutive probes; assuming "
+            "coordinatorship as deterministic successor",
+            dead.id, self._probe_failures.get(dead.id, 0),
+        )
+        for n in self.cluster.nodes:
+            n.is_coordinator = n.id == self.node.id
+        self.node.is_coordinator = True
+        self.topology.save(self.cluster.nodes)
+        for n in alive:
+            if n.id == self.node.id:
+                continue
+            try:
+                self.client.send_message(
+                    n, {"type": "set-coordinator", "nodeID": self.node.id}
+                )
+            except ClientError as e:
+                self.logger.error(
+                    "set-coordinator broadcast to %s failed: %s", n.id, e)
+
+    def _reconcile_dual_coordinator(self, peer: Node, status: dict) -> None:
+        """After a failover, a restarted old coordinator and the successor
+        can both claim the role. Deterministic resolution: lowest node id
+        wins; the loser clears its flag and adopts the winner. Applies
+        ONLY when both this node and the probed peer claim coordinatorship
+        themselves — a configured coordinator that simply isn't the lowest
+        id is never deposed by this rule."""
+        if not self.node.is_coordinator:
+            return
+        peer_id = status.get("localID")
+        peer_coord = next(
+            (n for n in status.get("nodes", []) if n.get("isCoordinator")),
+            None,
+        )
+        if not peer_coord or peer_coord.get("id") != peer_id:
+            return  # peer does not claim the role itself
+        if peer_id == self.node.id:
+            return
+        if peer_id < self.node.id:
+            self.logger.info(
+                "dual coordinator detected; yielding to %s (lower id)", peer_id)
+            for n in self.cluster.nodes:
+                n.is_coordinator = n.id == peer_id
+            self.node.is_coordinator = False
+            # Persist the DEMOTION too: open() restores flags from the
+            # checkpoint with authority over config, so a yield that only
+            # lives in memory would resurrect this node as a second
+            # coordinator on its next restart.
+            self.topology.save(self.cluster.nodes)
+        else:
+            try:
+                self.client.send_message(
+                    peer, {"type": "set-coordinator", "nodeID": self.node.id}
+                )
+            except ClientError:
+                pass
 
     def _monitor_translate_replication(self) -> None:
         data = self.client.translate_data(
@@ -689,6 +834,9 @@ class Server:
         elif typ == "set-coordinator":
             for n in self.cluster.nodes:
                 n.is_coordinator = n.id == msg["nodeID"]
+            # Persisted so a restart doesn't re-flag the deposed
+            # coordinator from a stale checkpoint (open() restores flags).
+            self.topology.save(self.cluster.nodes)
         elif typ == "remove-node":
             self.cluster.remove_node(msg["nodeID"])
         elif typ == "recalculate-caches":

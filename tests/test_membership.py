@@ -8,6 +8,7 @@ import time
 import pytest
 
 from pilosa_tpu.cluster.hash import ModHasher
+from pilosa_tpu.cluster.node import Node
 from pilosa_tpu.constants import SHARD_WIDTH
 from pilosa_tpu.server.client import InternalClient
 from pilosa_tpu.server.server import Server
@@ -312,3 +313,175 @@ def test_resize_aborts_on_failed_fetch(tmp_path):
         assert s.executor.execute("r", "Count(Row(f=1))") == [1]
     finally:
         s.close()
+
+
+def test_coordinator_failover_and_join_via_successor(tmp_path):
+    """Kill the coordinator of a 3-node cluster: the surviving node with
+    the lowest id must assume coordinatorship on its own (no manual
+    set-coordinator — the reference blocks here, api.go:777), the other
+    survivor must learn the new coordinator, and a brand-new node must
+    then be able to join via EITHER survivor."""
+    ports = [free_port() for _ in range(3)]
+    hosts = [f"localhost:{p}" for p in ports]
+    coord_host = min(hosts)  # make the DYING node the lowest id: the
+    # successor choice (lowest alive) then provably re-evaluates
+    servers = {}
+    try:
+        for i, port in enumerate(ports):
+            servers[hosts[i]] = make_server(
+                tmp_path, f"n{i}", port,
+                cluster_hosts=hosts,
+                is_coordinator=hosts[i] == coord_host,
+                member_monitor_interval=0.2,
+                member_probe_timeout=0.5,
+                coordinator_failover_probes=2,
+            )
+        survivors = sorted(h for h in hosts if h != coord_host)
+        # Everyone learns the configured coordinator via status probes.
+        assert wait_for(lambda: all(
+            (servers[h].cluster.coordinator_node() or Node(id="")).id == coord_host
+            for h in survivors
+        )), "peers never learned the configured coordinator"
+
+        servers.pop(coord_host).close()
+
+        successor = survivors[0]  # lowest surviving id
+        assert wait_for(lambda: servers[successor].node.is_coordinator, 15), \
+            "successor never assumed coordinatorship"
+        # The other survivor learns it via the set-coordinator broadcast.
+        other = survivors[1]
+        assert wait_for(lambda: (
+            servers[other].cluster.coordinator_node() or Node(id="")
+        ).id == successor, 15)
+
+        # A new node can now join via the NON-coordinator survivor (the
+        # join is forwarded to the successor).
+        s3 = make_server(tmp_path, "n3", free_port(), join_addr=other)
+        servers["n3"] = s3
+        assert wait_for(
+            lambda: servers[successor].cluster.node_by_id(s3.node.id) is not None
+        ), "join via successor failed"
+    finally:
+        for s in servers.values():
+            s.close()
+
+
+def test_failover_survivor_that_missed_broadcast_self_heals(tmp_path):
+    """A survivor that missed the set-coordinator broadcast must still
+    converge: probing the successor (an alive self-claimer) clears the
+    dead coordinator's stale flag, and coordinator_node() prefers the
+    alive claimant meanwhile."""
+    ports = [free_port() for _ in range(3)]
+    hosts = sorted(f"localhost:{p}" for p in ports)
+    coord, succ, other = hosts[0], hosts[1], hosts[2]
+    servers = {}
+    try:
+        for h in hosts:
+            servers[h] = make_server(
+                tmp_path, h.replace(":", "_"), int(h.rsplit(":", 1)[1]),
+                cluster_hosts=hosts, is_coordinator=h == coord,
+                member_monitor_interval=0.2, member_probe_timeout=0.5,
+                coordinator_failover_probes=2,
+            )
+        assert wait_for(lambda: all(
+            (servers[h].cluster.coordinator_node() or Node(id="")).id == coord
+            for h in (succ, other)
+        ))
+        # Simulate the missed broadcast: drop set-coordinator sends to
+        # `other` by making the successor's client fail for that node.
+        real_send = servers[succ].client.send_message
+
+        def lossy_send(node, msg):
+            if msg.get("type") == "set-coordinator" and node.id == other:
+                from pilosa_tpu.server.client import ClientError
+                raise ClientError("injected drop", status=0)
+            return real_send(node, msg)
+
+        servers[succ].client.send_message = lossy_send
+        servers.pop(coord).close()
+        assert wait_for(lambda: servers[succ].node.is_coordinator, 15)
+        # `other` never got the broadcast, but its probe of the successor
+        # sees the self-claim, clears the dead holdover, and routes to the
+        # live coordinator.
+        assert wait_for(lambda: (
+            servers[other].cluster.coordinator_node() or Node(id="")
+        ).id == succ, 15)
+        dead = servers[other].cluster.node_by_id(coord)
+        assert wait_for(lambda: not dead.is_coordinator, 15)
+    finally:
+        for s in servers.values():
+            s.close()
+
+
+def test_failover_promotion_survives_restart(tmp_path):
+    """A promoted successor restarting on its original (non-coordinator)
+    config must re-assume the role from the persisted topology — else the
+    cluster converges to zero coordinators."""
+    ports = [free_port() for _ in range(3)]
+    hosts = sorted(f"localhost:{p}" for p in ports)
+    coord, succ, other = hosts[0], hosts[1], hosts[2]
+    servers = {}
+    try:
+        for h in hosts:
+            servers[h] = make_server(
+                tmp_path, h.replace(":", "_"), int(h.rsplit(":", 1)[1]),
+                cluster_hosts=hosts, is_coordinator=h == coord,
+                member_monitor_interval=0.2, member_probe_timeout=0.5,
+                coordinator_failover_probes=2,
+            )
+        assert wait_for(lambda: all(
+            (servers[h].cluster.coordinator_node() or Node(id="")).id == coord
+            for h in (succ, other)
+        ))
+        servers.pop(coord).close()
+        assert wait_for(lambda: servers[succ].node.is_coordinator, 15)
+        # Restart the successor with its ORIGINAL config (is_coordinator
+        # False): the persisted topology must restore the claim.
+        servers.pop(succ).close()
+        servers[succ] = make_server(
+            tmp_path, succ.replace(":", "_"), int(succ.rsplit(":", 1)[1]),
+            cluster_hosts=hosts, is_coordinator=False,
+            member_monitor_interval=0.2, member_probe_timeout=0.5,
+            coordinator_failover_probes=2,
+        )
+        assert servers[succ].node.is_coordinator, \
+            "promotion did not survive restart"
+    finally:
+        for s in servers.values():
+            s.close()
+
+
+def test_late_starter_learns_coordinator_third_party(tmp_path):
+    """A node that starts while knowing no coordinator must adopt a peer's
+    view of who holds the role (third-party claim), so failover can still
+    identify whose death to detect."""
+    ports = [free_port() for _ in range(3)]
+    hosts = sorted(f"localhost:{p}" for p in ports)
+    coord, mid, late = hosts[0], hosts[1], hosts[2]
+    servers = {}
+    try:
+        for h in (coord, mid):
+            servers[h] = make_server(
+                tmp_path, h.replace(":", "_"), int(h.rsplit(":", 1)[1]),
+                cluster_hosts=hosts, is_coordinator=h == coord,
+                member_monitor_interval=0.2, member_probe_timeout=0.5,
+                coordinator_failover_probes=0,  # no promotion racing the
+                # third-party adoption this test asserts
+            )
+        assert wait_for(lambda: (
+            servers[mid].cluster.coordinator_node() or Node(id="")
+        ).id == coord)
+        # Kill the coordinator BEFORE the late node starts: the late node
+        # can only learn the role third-party, from mid's view.
+        servers.pop(coord).close()
+        servers[late] = make_server(
+            tmp_path, late.replace(":", "_"), int(late.rsplit(":", 1)[1]),
+            cluster_hosts=hosts, is_coordinator=False,
+            member_monitor_interval=0.2, member_probe_timeout=0.5,
+        )
+        assert wait_for(lambda: (
+            servers[late].cluster.node_by_id(coord) or Node(id="")
+        ).is_coordinator, 15), "late starter never learned the coordinator"
+    finally:
+        for s in servers.values():
+            s.close()

@@ -228,145 +228,6 @@ def test_engine_leaf_cache_eviction_under_tiny_budget(holder, ex, monkeypatch):
     assert got.tolist() == [want] * 3
 
 
-def test_query_coalescer_batches_concurrent_counts(holder, ex):
-    """Concurrent fast-path Counts coalesce into one batched device
-    program with per-query results identical to direct execution.
-    Coalesced run goes FIRST (a prior direct run would populate the
-    result memo and answer every repeat without a batch)."""
-    import threading
-
-    from pilosa_tpu.parallel.coalescer import QueryCoalescer
-
-    expected = plant(holder, ex)
-    engine = ShardedQueryEngine(holder)
-    co = QueryCoalescer(engine, window=0.05, force=True)
-    shards = list(range(5))
-    queries = [
-        "Intersect(Row(f=1), Row(g=3))",
-        "Intersect(Row(f=1), Row(f=2))",
-        "Intersect(Row(f=2), Row(g=3))",
-        "Intersect(Row(f=1), Row(g=3))",
-    ] * 3
-    calls = [parse(q).calls[0] for q in queries]
-
-    results = [None] * len(calls)
-    def worker(i):
-        results[i] = co.count("i", calls[i], shards)
-    threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(calls))]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    co.close()
-    singles = [engine.count("i", c, shards) for c in calls]
-    assert results == singles
-    # At least one multi-query batch actually executed.
-    assert co.batches_executed >= 1 and co.queries_batched >= 2
-
-
-def test_coalescer_single_query_window(holder, ex):
-    """A window that catches exactly ONE query takes the single-dispatch
-    branch (no batch) and must still answer correctly — regression for the
-    6-tuple unpack crash that 500'd lone-window queries."""
-    from pilosa_tpu.parallel.coalescer import QueryCoalescer
-
-    plant(holder, ex)
-    engine = ShardedQueryEngine(holder)
-    co = QueryCoalescer(engine, window=0.001, force=True)
-    shards = list(range(5))
-    call = parse("Intersect(Row(f=1), Row(g=3))").calls[0]
-    try:
-        got = co.count("i", call, shards)  # lone query -> group of 1
-        assert co.batches_executed == 0  # single-dispatch branch taken
-        # The memo was fed by the FINISHER: probe it directly before
-        # anything else could repopulate it (engine.count would memo_store
-        # on a miss and make this assertion vacuous).
-        comp, _ = engine._compile("i", call)
-        hit, _ = engine.memo_probe("i", comp, tuple(shards))
-        assert hit == got
-        assert got == engine.count("i", call, shards)
-    finally:
-        co.close()
-
-
-def test_coalescer_adaptive_regimes():
-    """The round-3 regression fix: batching is bypassed on a remote-runtime
-    link (blocking clients already pipeline N RTTs) and on idle traffic,
-    and engages on a local backend under overlapping arrivals."""
-    from pilosa_tpu.parallel.coalescer import QueryCoalescer
-
-    co = QueryCoalescer(engine=None, window=0.001)
-    # Remote-runtime regime: 70ms RTT >> 10ms bypass threshold.
-    co.rtt = 0.070
-    co._ewma_dt = 0.0001  # even under heavy arrivals
-    assert not co._should_batch()
-    # Local regime, overlapping arrivals: batch.
-    co.rtt = 0.0005
-    co._ewma_dt = 0.0001
-    assert co._should_batch()
-    # Local regime, idle traffic: a lone query must not pay the window.
-    co._ewma_dt = 1.0
-    assert not co._should_batch()
-    co.close()
-
-
-def test_coalescer_reduces_dispatches_deterministically():
-    """The batching win, isolated from wall-clock noise: N concurrent
-    queries through the coalescer reach the engine in FAR fewer dispatches
-    than N, with every result routed back to the right caller."""
-    import threading
-    import time as _time
-    from types import SimpleNamespace
-
-    from pilosa_tpu.parallel.coalescer import QueryCoalescer
-
-    class FakeEngine:
-        """Counts dispatches; every query's 'count' is its own row id so
-        cross-wired results would be detected."""
-
-        def __init__(self):
-            self.dispatches = 0
-
-        def _compile(self, index, call):
-            return (SimpleNamespace(signature=[("row", 0)], leaves=[call]), None)
-
-        def memo_probe(self, index, comp, shards):
-            return None, ("key", "fp")
-
-        def memo_store(self, *a):
-            pass
-
-        def count_async(self, index, call, shards, comp_expr=None):
-            self.dispatches += 1
-            _time.sleep(0.002)
-            return np.array([call])
-
-        def count_batch_async(self, index, calls, shards, comps=None):
-            self.dispatches += 1
-            _time.sleep(0.002)
-            return np.array(calls)
-
-    eng = FakeEngine()
-    co = QueryCoalescer(eng, window=0.05, force=True)
-    n = 32
-    results = [None] * n
-
-    def worker(i):
-        results[i] = co.count("i", i, (0,))
-
-    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    co.close()
-    assert results == list(range(n))  # per-caller routing intact
-    # The win: far fewer dispatches than queries. Bound is loose (n/2, not
-    # n/8) because a loaded CI machine can split the burst across windows.
-    assert eng.dispatches <= n // 2, eng.dispatches
-    assert co.queries_batched >= 2
-
-
 def test_engine_memo_skips_device_on_repeat(holder, ex):
     """Hot-query result memo: a repeat query is answered host-side (memo
     hit) and invalidated by fragment generation bumps."""
@@ -386,17 +247,6 @@ def test_engine_memo_skips_device_on_repeat(holder, ex):
     got = engine.count("i", call, shards)
     in_g3 = new_col in expected[("g", 3)]
     assert got == want + (1 if in_g3 else 0)
-
-
-def test_executor_coalesce_window_wiring(holder, ex):
-    """Executor with coalesce_window routes fast-path Count through the
-    coalescer and still returns correct results."""
-    expected = plant(holder, ex)
-    ex2 = Executor(holder, workers=0, coalesce_window=0.001)
-    want = len(expected[("f", 1)] & expected[("g", 3)])
-    assert ex2.execute("i", "Count(Intersect(Row(f=1), Row(g=3)))") == [want]
-    assert ex2.coalescer is not None
-    ex2.coalescer.close()
 
 
 def test_topn_shard_counts_memo_and_invalidation(holder, ex):

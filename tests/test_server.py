@@ -43,65 +43,6 @@ def host(s):
     return f"localhost:{s.port}"
 
 
-def test_concurrent_http_clients_coalesce(tmp_path, client, monkeypatch):
-    """16 parallel HTTP clients with query_coalesce_window=1ms: every
-    answer is correct AND the coalescer provably batched (batches_executed
-    counted, queries_batched > batches) — the serving-throughput claim in
-    parallel/coalescer.py exercised through the real threaded HTTP stack.
-    Batching is forced on: the adaptive regime gate is unit-tested in
-    test_parallel.py; this test verifies the HTTP wiring."""
-    from concurrent.futures import ThreadPoolExecutor
-
-    monkeypatch.setenv("PILOSA_COALESCE_FORCE", "1")
-    # Memo off: repeats would otherwise be answered host-side and starve
-    # the coalescer, making "did every query ride the batching path" a
-    # timing lottery instead of a deterministic assertion.
-    monkeypatch.setenv("PILOSA_MEMO_ENTRIES", "0")
-    s = Server(
-        data_dir=str(tmp_path / "co"),
-        cache_flush_interval=0,
-        query_coalesce_window=0.002,
-    )
-    s.open()
-    try:
-        h = host(s)
-        client.create_index(h, "co")
-        client.create_field(h, "co", "f")
-        n_rows = 8
-        for row in range(n_rows):
-            for col in range(row + 1):  # row r has r+1 bits
-                client.query(h, "co", f"Set({col * 7}, f={row})")
-
-        n_clients, per_client = 16, 12
-        local = InternalClient()
-
-        def worker(wid):
-            got = []
-            for i in range(per_client):
-                row = (wid + i) % n_rows
-                resp = local.query(h, "co", f"Count(Row(f={row}))")
-                got.append((row, resp["results"][0]))
-            return got
-
-        with ThreadPoolExecutor(max_workers=n_clients) as pool:
-            results = list(pool.map(worker, range(n_clients)))
-        for got in results:
-            for row, count in got:
-                assert count == row + 1, (row, count)
-
-        co = s.executor.coalescer
-        assert co is not None
-        # With the memo off every query rides the coalescer; 16 concurrent
-        # clients against 2ms windows make at least one multi-query batch
-        # all but certain (exact grouping counts are a timing lottery — the
-        # grouping math itself is unit-tested in test_parallel.py; lone
-        # windows exercise the single-query dispatch branch instead).
-        assert co.batches_executed >= 1
-        assert co.queries_batched > co.batches_executed
-    finally:
-        s.close()
-
-
 def test_getting_started_flow(server, client):
     """README stargazer flow: create schema, set bits, query."""
     client.create_index(host(server), "repository")
