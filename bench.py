@@ -556,7 +556,11 @@ def bench_big():
     plane_bytes = n_shards * WORDS_PER_ROW * 4
     stack_bytes = n_rows * plane_bytes
     out = {"shards": n_shards, "rows": n_rows,
-           "stack_gib": round(stack_bytes / 2**30, 3)}
+           "stack_gib": round(stack_bytes / 2**30, 3),
+           # ~50% density random planes: the set-bit count positions this
+           # stanza against the reference's 1B+-row workloads
+           # (docs/examples.md:16 NYC taxi).
+           "set_bits_approx": int(stack_bytes * 8 * 0.5)}
 
     rng = np.random.default_rng(11)
     holder = Holder(None)

@@ -468,6 +468,15 @@ def _dec_set_coordinator(data: bytes) -> dict:
     return {"type": "set-coordinator", "nodeID": m.New.ID}
 
 
+def _dec_update_coordinator(data: bytes) -> dict:
+    # The reference's UpdateCoordinatorMessage (broadcast after a
+    # SetCoordinator lands, server.go receiveMessage) has identical
+    # semantics to our set-coordinator dispatch: apply the new flags.
+    m = pb.UpdateCoordinatorMessage()
+    m.ParseFromString(data)
+    return {"type": "set-coordinator", "nodeID": m.New.ID}
+
+
 def _enc_node_event(msg: dict):
     m = pb.NodeEventMessage()
     if msg["type"] == "node-join":
@@ -576,6 +585,7 @@ _DECODERS: Dict[int, Callable[[bytes], dict]] = {
     TYPE_RESIZE_INSTRUCTION: _dec_resize_instruction,
     TYPE_RESIZE_INSTRUCTION_COMPLETE: _dec_resize_complete,
     TYPE_SET_COORDINATOR: _dec_set_coordinator,
+    TYPE_UPDATE_COORDINATOR: _dec_update_coordinator,
     TYPE_NODE_STATE: _dec_node_state,
     TYPE_RECALCULATE_CACHES: _dec_recalculate,
     TYPE_NODE_EVENT: _dec_node_event,

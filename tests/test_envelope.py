@@ -78,6 +78,18 @@ def test_round_trip(msg):
         assert got[key] == want, f"{key}: {got[key]!r} != {want!r}"
 
 
+def test_update_coordinator_decodes():
+    # Reference UpdateCoordinatorMessage (type byte 11) must decode to the
+    # same dispatch as set-coordinator, not raise on an unknown type.
+    from pilosa_tpu.server.proto import private_pb2 as pb
+
+    m = pb.UpdateCoordinatorMessage()
+    m.New.ID = "n9"
+    got = env.decode_message(
+        bytes([env.TYPE_UPDATE_COORDINATOR]) + m.SerializeToString())
+    assert got == {"type": "set-coordinator", "nodeID": "n9"}
+
+
 def test_node_update_event_decodes_as_update_not_leave():
     # Reference nodeUpdate (event.go:23) must never decode as a leave.
     from pilosa_tpu.server.proto import private_pb2 as pb

@@ -485,3 +485,32 @@ def test_late_starter_learns_coordinator_third_party(tmp_path):
     finally:
         for s in servers.values():
             s.close()
+
+
+def test_failover_requires_strict_majority(tmp_path):
+    """In a 2-node cluster the survivor is NOT a strict majority (1 of 2):
+    it must never self-promote — a network partition would otherwise
+    elect a second coordinator on each side."""
+    ports = sorted(free_port() for _ in range(2))
+    hosts = [f"localhost:{p}" for p in ports]
+    coord, other = hosts[0], hosts[1]
+    servers = {}
+    try:
+        for h in hosts:
+            servers[h] = make_server(
+                tmp_path, h.replace(":", "_"), int(h.rsplit(":", 1)[1]),
+                cluster_hosts=hosts, is_coordinator=h == coord,
+                member_monitor_interval=0.2, member_probe_timeout=0.5,
+                coordinator_failover_probes=2,
+            )
+        assert wait_for(lambda: (
+            servers[other].cluster.coordinator_node() or Node(id="")
+        ).id == coord)
+        servers.pop(coord).close()
+        # Give the survivor ample probe rounds to (wrongly) promote.
+        time.sleep(3.0)
+        assert not servers[other].node.is_coordinator, \
+            "survivor promoted without a strict majority"
+    finally:
+        for s in servers.values():
+            s.close()
