@@ -18,8 +18,7 @@ from __future__ import annotations
 
 import os
 import threading
-from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,9 +32,10 @@ from ..pql.ast import BETWEEN, Call, GT, GTE, LT, LTE, NEQ
 from .mesh import SHARD_AXIS, default_mesh, pad_shards, shard_sharding
 
 
-@dataclass(frozen=True)
-class Leaf:
-    """A fragment row that must be materialized on device."""
+class Leaf(NamedTuple):
+    """A fragment row that must be materialized on device. NamedTuple,
+    not frozen dataclass: Leaf construction/hash/eq run per call on the
+    batch-serving hot path (slot dicts, cache keys)."""
 
     field: str
     view: str
@@ -47,23 +47,41 @@ class _Compiler:
     (L, S, W) leaf tensor, so the jitted program is cacheable per structure
     signature (predicates are baked in and included in the signature)."""
 
-    def __init__(self, holder, index: str):
+    def __init__(self, holder, index: str, field_cache: Optional[Dict] = None):
         self.holder = holder
         self.index = index
         self.leaves: List[Leaf] = []
+        self._slots: Dict[Leaf, int] = {}
         self.signature: List = []
+        # Shared across one batch's compilers: a 1024-query batch would
+        # otherwise repeat the same holder field-existence lookups per call.
+        self._field_cache = field_cache
 
     def leaf_index(self, leaf: Leaf) -> int:
-        try:
-            return self.leaves.index(leaf)
-        except ValueError:
+        # Dict, not list.index: compilation is per-call serving-path work
+        # (a 1024-query batch compiles 1024 trees), and the linear scan
+        # was the single largest host cost in batch assembly.
+        i = self._slots.get(leaf)
+        if i is None:
+            i = len(self.leaves)
             self.leaves.append(leaf)
-            return len(self.leaves) - 1
+            self._slots[leaf] = i
+        return i
+
+    def _field_exists(self, field_name: str) -> bool:
+        fc = self._field_cache
+        if fc is not None:
+            ok = fc.get(field_name)
+            if ok is None:
+                ok = self.holder.field(self.index, field_name) is not None
+                fc[field_name] = ok
+            return ok
+        return self.holder.field(self.index, field_name) is not None
 
     def compile(self, c: Call) -> Callable:
         if c.name == "Row":
             field_name = c.field_arg()
-            if self.holder.field(self.index, field_name) is None:
+            if not self._field_exists(field_name):
                 raise FieldNotFoundError(field_name)
             row_id, ok = c.uint_arg(field_name)
             if not ok:
@@ -512,8 +530,8 @@ class ShardedQueryEngine:
 
     # -------------------------------------------------------------- queries
 
-    def _compile(self, index: str, call: Call):
-        comp = _Compiler(self.holder, index)
+    def _compile(self, index: str, call: Call, field_cache: Optional[Dict] = None):
+        comp = _Compiler(self.holder, index, field_cache=field_cache)
         expr = comp.compile(call)
         return comp, expr
 
@@ -575,7 +593,8 @@ class ShardedQueryEngine:
         per-call serving at ~1/RTT). Queries answered by the result memo
         skip the device entirely; only misses ride the batched program."""
         shards = tuple(shards)
-        comps = [self._compile(index, c) for c in calls]
+        fcache: Dict = {}
+        comps = [self._compile(index, c, field_cache=fcache) for c in calls]
         out = np.empty(len(calls), dtype=np.int64)
         miss = []
         tokens = {}
@@ -607,11 +626,15 @@ class ShardedQueryEngine:
         calls (must align 1:1 with `calls`)."""
         shards = tuple(shards)
         if comps is None:
-            comps = [self._compile(index, c) for c in calls]
-        sig0 = tuple(comps[0][0].signature)
+            fcache: Dict = {}
+            comps = [self._compile(index, c, field_cache=fcache) for c in calls]
+        # List comparison (not per-call tuple()): this runs once per query
+        # on the serving hot path.
+        sig0_list = comps[0][0].signature
         for comp, _ in comps[1:]:
-            if tuple(comp.signature) != sig0:
+            if comp.signature != sig0_list:
                 raise QueryError("count_batch requires structurally identical queries")
+        sig0 = tuple(sig0_list)
 
         # Set-op trees (Row/Intersect/Union/Difference/Xor) are elementwise,
         # so the whole batch vectorizes: dedupe the batch's leaf rows into one

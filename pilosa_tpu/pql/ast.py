@@ -10,6 +10,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List
 
+from ..errors import QueryError
+
 # Condition ops.
 EQ = "eq"
 NEQ = "neq"
@@ -55,16 +57,12 @@ class Call:
 
     def field_arg(self) -> str:
         """The (single) non-reserved argument key (ast.go Call.FieldArg)."""
-        from ..errors import QueryError
-
         for key in sorted(self.args):
             if key not in RESERVED:
                 return key
         raise QueryError(f"{self.name}() argument required: field")
 
     def uint_arg(self, key: str):
-        from ..errors import QueryError
-
         v = self.args.get(key)
         if v is None:
             return 0, False
