@@ -210,6 +210,18 @@ class Container:
         forms; free for run containers)."""
         return self.runs if self.runs is not None else _runs_of_array(self.to_array())
 
+    def run_count_lazy(self):
+        """(run count, run pairs or None): the count without materializing
+        a bitmap container's value list (one popcount pass). Callers that
+        decide the run form WINS call run_pairs() then — sizing a form
+        must not cost a conversion (this dominated snapshot time)."""
+        if self.runs is not None:
+            return len(self.runs), self.runs
+        if self.arr is not None:
+            runs = _runs_of_array(self.arr)
+            return len(runs), runs
+        return _bits_run_count(self.bits), None
+
     # ----------------------------------------------------- form management
 
     def _maybe_densify(self) -> None:
@@ -245,16 +257,10 @@ class Container:
         adversarial contiguous imports memory-bounded."""
         if self.runs is not None or self.n == 0:
             return
-        if self.arr is not None:
-            cur_bytes = 2 * self.n
-            runs = _runs_of_array(self.arr)
-            r = len(runs)
-        else:
-            if not self.nv:
-                return  # lazily-opened: don't page in to maybe-compress
-            cur_bytes = 8 * BITMAP_N
-            runs = None
-            r = _bits_run_count(self.bits)  # cheap; no value list yet
+        if self.bits is not None and not self.nv:
+            return  # lazily-opened: don't page in to maybe-compress
+        cur_bytes = 2 * self.n if self.arr is not None else 8 * BITMAP_N
+        r, runs = self.run_count_lazy()
         if r <= RUN_MAX_SIZE and 4 * r * 2 <= cur_bytes:
             self.runs = runs if runs is not None else _runs_of_array(self.to_array())
             self.arr = None
@@ -916,13 +922,13 @@ class Bitmap:
             # misparses the tail as op-log). Settle it now.
             cont.verify_n()
             n = cont.n
-            runs = cont.run_pairs()
+            r, runs = cont.run_count_lazy()
             sizes = {
                 CONTAINER_ARRAY: 2 * n,
                 CONTAINER_BITMAP: 8 * BITMAP_N,
-                CONTAINER_RUN: 2 + 4 * len(runs),
+                CONTAINER_RUN: 2 + 4 * r,
             }
-            if len(runs) > RUN_MAX_SIZE:
+            if r > RUN_MAX_SIZE:
                 del sizes[CONTAINER_RUN]
             if n > ARRAY_MAX_SIZE:
                 del sizes[CONTAINER_ARRAY]
@@ -930,6 +936,8 @@ class Bitmap:
             if typ == CONTAINER_ARRAY:
                 data = cont.to_array().astype("<u2").tobytes()
             elif typ == CONTAINER_RUN:
+                if runs is None:  # bitmap container that runifies on disk
+                    runs = cont.run_pairs()
                 data = struct.pack("<H", len(runs)) + runs.astype("<u2").tobytes()
             else:
                 data = cont.as_words().astype("<u8").tobytes()
