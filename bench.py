@@ -794,6 +794,16 @@ def bench_import():
         out["snapshot_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
         out["random_file_mib"] = round(
             os.path.getsize(os.path.join(d, "rand")) / 2**20, 2)
+        # Merkle block checksums (BASELINE.md row: Fragment Blocks scan,
+        # reference fragment_internal_test.go:1020-1039) — cold then
+        # cached (the anti-entropy sweep hits the cache).
+        t0 = time.perf_counter()
+        n_blocks = len(f.blocks())
+        out["blocks_cold_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        t0 = time.perf_counter()
+        f.blocks()
+        out["blocks_cached_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        out["blocks_n"] = n_blocks
         f.close()
 
         # Contiguous: the adversarial-RLE shape; must land as runs.

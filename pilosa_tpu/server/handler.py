@@ -549,6 +549,15 @@ class Handler:
 class _RequestHandler(BaseHTTPRequestHandler):
     handler: Handler = None  # set by serve()
     protocol_version = "HTTP/1.1"
+    # Nagle off (StreamRequestHandler.setup reads this): the response is
+    # written as several small sends, and with Nagle on a keep-alive
+    # client stalls ~40ms per request on the delayed-ACK interaction.
+    disable_nagle_algorithm = True
+    # Idle keep-alive read timeout: without it every silent client pins a
+    # handler thread in readline() forever (handle_one_request maps a
+    # socket timeout to close_connection). Clients bound their reuse to
+    # well under this (InternalClient.IDLE_REUSE_S).
+    timeout = 60
 
     def _do(self, method: str):
         parsed = urlparse(self.path)
@@ -600,6 +609,43 @@ class _Server(ThreadingHTTPServer):
     # enough that the OS queue, not the library, is the limit.
     request_queue_size = 128
     daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # Live per-connection sockets: keep-alive means a handler thread
+        # can sit in readline() long after the listener closes, so
+        # server_close must SEVER established connections too (Go's
+        # http.Server.Close semantics) — otherwise an in-process "dead"
+        # node keeps answering its pooled peers forever.
+        self._live = set()
+        self._live_mu = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._live_mu:
+            self._live.add(request)
+        super().process_request(request, client_address)
+
+    def close_request(self, request):
+        with self._live_mu:
+            self._live.discard(request)
+        super().close_request(request)
+
+    def server_close(self):
+        super().server_close()
+        import socket as _socket
+
+        with self._live_mu:
+            live = list(self._live)
+            self._live.clear()
+        for sock in live:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 def serve(handler: Handler, host: str = "localhost", port: int = 0,
