@@ -3,18 +3,19 @@
 Port of the interface in /root/reference/client.go:34-60 and implementation
 http/client.go: query fan-out, import routing, fragment block diff, shard
 retrieval for resize, cluster message send, translate-log streaming.
-Uses stdlib urllib (JSON wire).
+Transport: stdlib http.client over per-thread keep-alive connection pools
+(see _conn); wire format JSON/protobuf per route.
 """
 
 from __future__ import annotations
 
 import http.client
 import json
+import socket
 import struct
 import threading
-import urllib.error
+import time
 import urllib.parse
-import urllib.request
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..errors import PilosaError
@@ -91,15 +92,13 @@ class InternalClient:
         every node-to-node call (fan-out, replication, heartbeats);
         pooled HTTP/1.1 connections cut a serial query round trip ~2x.
         Thread-local, so no cross-thread sharing of http.client state."""
-        import time as _time
-
         pool = getattr(self._local, "conns", None)
         if pool is None:
             pool = self._local.conns = {}
         entry = pool.get((scheme, netloc))
         if entry is not None:
             conn, last_used = entry
-            if _time.monotonic() - last_used < self.IDLE_REUSE_S:
+            if time.monotonic() - last_used < self.IDLE_REUSE_S:
                 return conn
             conn.close()
             del pool[(scheme, netloc)]
@@ -114,19 +113,15 @@ class InternalClient:
         conn.connect()
         # Nagle off: small keep-alive requests otherwise stall ~40ms
         # per round trip on the delayed-ACK interaction.
-        import socket as _socket
-
-        conn.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-        pool[(scheme, netloc)] = (conn, _time.monotonic())
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        pool[(scheme, netloc)] = (conn, time.monotonic())
         return conn
 
     def _touch_conn(self, scheme: str, netloc: str) -> None:
-        import time as _time
-
         pool = getattr(self._local, "conns", None)
         if pool is not None and (scheme, netloc) in pool:
             pool[(scheme, netloc)] = (
-                pool[(scheme, netloc)][0], _time.monotonic())
+                pool[(scheme, netloc)][0], time.monotonic())
 
     def _drop_conn(self, scheme: str, netloc: str) -> None:
         pool = getattr(self._local, "conns", None)
@@ -160,12 +155,8 @@ class InternalClient:
             sent = False
             try:
                 conn = self._conn(parts.scheme, parts.netloc)
-                try:
-                    conn.request(method, path, body=body, headers=headers)
-                    sent = True
-                except (http.client.CannotSendRequest, BrokenPipeError,
-                        ConnectionResetError):
-                    raise
+                conn.request(method, path, body=body, headers=headers)
+                sent = True
                 resp = conn.getresponse()
                 data = resp.read()
             except (http.client.HTTPException, ConnectionError, OSError) as e:
