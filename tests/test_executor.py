@@ -415,3 +415,38 @@ def test_durability_across_reopen(holder, ex, tmp_path):
     holder.reopen()
     ex2 = Executor(holder, translate_store=TranslateStore().open(), workers=0)
     assert list(ex2.execute("i", "Row(f=10)")[0].columns()) == [3, SHARD_WIDTH + 7]
+
+
+def test_topn_chunked_matches_single_chunk(holder, ex, monkeypatch):
+    """A tiny PILOSA_TOPN_CHUNK_BYTES forces the TopN phases through many
+    small device chunks; results must equal the single-chunk run (the
+    chunk bound exists so 256-shard stacks don't build 16 GiB programs)."""
+    import numpy as np
+
+    setup_index(holder)
+    rng = np.random.default_rng(23)
+    fld = holder.index("i").field("f")
+    g = holder.index("i").field("g")
+    n_rows, n_shards = 40, 2
+    rows, cols = [], []
+    for row in range(n_rows):
+        for s in range(n_shards):
+            c = rng.choice(4096, size=32 + row, replace=False)
+            rows.extend([row] * len(c))
+            cols.extend(int(s * SHARD_WIDTH + x) for x in c)
+    fld.import_bits(rows, cols)
+    gc = [int(s * SHARD_WIDTH + x)
+          for s in range(n_shards) for x in rng.choice(4096, 1200, replace=False)]
+    g.import_bits([3] * len(gc), gc)
+
+    q = "TopN(f, Row(g=3), n=6)"
+    want = [(p.id, p.count) for p in ex.execute("i", q)[0]]
+    assert want, "TopN returned nothing; test data broken"
+
+    # 16 rows per chunk at 2 shards x 128 KiB planes.
+    monkeypatch.setenv("PILOSA_TOPN_CHUNK_BYTES", str(16 * 2 * 32768 * 4))
+    from pilosa_tpu import executor as ex_mod
+
+    assert ex_mod._topn_chunk(n_shards) == 16
+    got = [(p.id, p.count) for p in ex.execute("i", q)[0]]
+    assert got == want, (got, want)
