@@ -1,9 +1,9 @@
 """Metrics abstraction (port of /root/reference/stats.go).
 
 StatsClient interface: count/gauge/histogram/set/timing with tag scoping.
-Implementations: Nop, InMemory (expvar-equivalent, JSON-dumpable), Multi.
-A statsd/datadog emitter can be layered on InMemory via polling; the
-reference's datadog client (statsd/) maps to emit hooks here.
+Implementations: Nop, InMemory (expvar-equivalent, JSON-dumpable), Multi,
+and StatsDClient (UDP fire-and-forget, datadog wire format — the
+reference's statsd/statsd.go), selected by config via new_stats_client.
 """
 
 from __future__ import annotations
