@@ -647,6 +647,18 @@ class _Server(ThreadingHTTPServer):
             except OSError:
                 pass
 
+    def handle_error(self, request, client_address):
+        """Peer disconnects (reset/broken pipe/timeouts) are routine with
+        keep-alive pools and severed-on-close peers — not stderr-traceback
+        events. Anything else keeps the stdlib's loud default."""
+        import sys
+
+        exc = sys.exception()
+        if isinstance(exc, (ConnectionResetError, BrokenPipeError,
+                            ConnectionAbortedError, TimeoutError)):
+            return
+        super().handle_error(request, client_address)
+
 
 def serve(handler: Handler, host: str = "localhost", port: int = 0,
           ssl_context=None) -> Tuple[ThreadingHTTPServer, threading.Thread, int]:
