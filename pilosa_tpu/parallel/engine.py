@@ -254,7 +254,8 @@ class ShardedQueryEngine:
         # pins an XLA executable, and a long-lived server seeing varied query
         # shapes would otherwise accumulate them without bound.
         self._fn_budget = int(os.environ.get("PILOSA_FN_CACHE_ENTRIES", 256))
-        self._building: Dict[Tuple, threading.Event] = {}
+        # key -> (Event, builder thread); see _gate.
+        self._building: Dict[Tuple, Tuple] = {}
         # The server handles requests on ThreadingHTTPServer threads, so
         # every cache (LRU touch included) mutates under concurrency. One
         # lock guards dict + byte-counter state; device work (gather,
@@ -294,29 +295,46 @@ class ShardedQueryEngine:
     def _gate(self, key, probe: Callable):
         """Return probe()'s non-None value, or None once the caller holds
         the build gate for `key` — the caller then MUST publish a value and
-        `_release(key)`, even on failure. Waiters re-probe when the builder
-        releases; the wait timeout + ownership steal means a died builder
-        costs one 10s stall, never a deadlock or a permanent stall."""
+        `_release(key)`, even on failure (_release runs in the builder's
+        finally). Waiters re-probe when the builder releases. Ownership is
+        stolen ONLY if the builder thread is no longer alive (interpreter
+        teardown — finally makes a leaked gate otherwise impossible):
+        stealing on a mere timeout would re-run 20-40s TPU compiles once
+        per waiter during a cold-start stampede."""
+        waited = 0
         while True:
             val = probe()
             if val is not None:
                 return val
             with self._lock:
-                ev = self._building.get(key)
-                if ev is None:
-                    self._building[key] = threading.Event()
+                entry = self._building.get(key)
+                if entry is None:
+                    self._building[key] = (
+                        threading.Event(), threading.current_thread())
                     return None
-            if not ev.wait(timeout=10.0):
+                ev, builder = entry
+            if ev.wait(timeout=10.0):
+                continue
+            waited += 1
+            # Liveness escape hatch for a WEDGED (alive) builder — e.g. a
+            # device call stuck on a dead tunnel: complain at 1 minute,
+            # steal at 5 (a redundant compile is the least of the problems
+            # then). A dead builder (interpreter teardown) steals at once.
+            if waited == 6:
+                self.counters["gate_stalls"] = \
+                    self.counters.get("gate_stalls", 0) + 1
+            if not builder.is_alive() or waited >= 30:
                 with self._lock:
-                    if self._building.get(key) is ev:
-                        self._building[key] = threading.Event()
+                    if self._building.get(key) is entry:
+                        self._building[key] = (
+                            threading.Event(), threading.current_thread())
                         return None
 
     def _release(self, key) -> None:
         with self._lock:
-            ev = self._building.pop(key, None)
-        if ev is not None:
-            ev.set()
+            entry = self._building.pop(key, None)
+        if entry is not None:
+            entry[0].set()
 
     def _fn_probe(self, cache: Dict[Tuple, Callable], sig: Tuple) -> Optional[Callable]:
         with self._lock:
