@@ -1279,6 +1279,12 @@ def main():
     partial["value"] = round(count_qps, 2)
     partial["vs_baseline"] = round(count_qps / host_qps, 3)
     partial["detail"]["host_cpu_qps"] = round(host_qps, 2)
+    # Release the headline stanza's device caches before the multi-GiB
+    # stanzas (bench_hbm builds an 8 GiB stack, bench_big up to ~10 GiB
+    # of leaf+stack cache on a 16 GiB chip — leftovers are the margin).
+    ex.close()
+    holder.close()
+    del holder, ex
 
     def stanza(name, fn):
         """Run one optional stanza; a crash records the error instead of
