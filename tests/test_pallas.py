@@ -82,3 +82,22 @@ def test_batched_gather_expr_count_w_chunked(monkeypatch):
     got = np.asarray(pk.batched_gather_expr_count(jnp.asarray(stacked), (ia, ib), expr))
     want = np.array([np_popcount(stacked[ia[i]] & stacked[ib[i]]) for i in range(q)])
     np.testing.assert_array_equal(got, want)
+
+
+def test_batched_gather_expr_count_wide_shard_axis():
+    """256-shard geometry (the bench_big TPU shape, W scaled down so
+    interpret mode stays fast): per-query gather blocks span a wide S
+    axis and must still count exactly."""
+    import jax.numpy as jnp
+
+    u, s, w, q = 4, 256, 256, 6
+    stacked = RNG.integers(0, 1 << 32, (u, s, w), dtype=np.uint32)
+    ia = RNG.integers(0, u, q).astype(np.int32)
+    ib = RNG.integers(0, u, q).astype(np.int32)
+
+    def expr(planes):
+        return jnp.bitwise_and(planes[0], planes[1])
+
+    got = np.asarray(pk.batched_gather_expr_count(jnp.asarray(stacked), (ia, ib), expr))
+    want = np.array([np_popcount(stacked[ia[i]] & stacked[ib[i]]) for i in range(q)])
+    np.testing.assert_array_equal(got, want)
