@@ -94,6 +94,8 @@ def _ctl_client(args):
 
 def cmd_import(args) -> int:
     client = _ctl_client(args)
+    if getattr(args, "both_keys", False):
+        args.index_keys = args.field_keys = True
     if args.create:
         client.ensure_index(args.host, args.index, {"keys": args.index_keys})
         field_opts = {
@@ -253,6 +255,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=10_000_000)
     p.add_argument("--index-keys", action="store_true")
     p.add_argument("--field-keys", action="store_true")
+    p.add_argument("-k", "--keys", dest="both_keys", action="store_true",
+                   help="treat both column and row values as string keys "
+                        "(shorthand for --index-keys --field-keys, the "
+                        "reference's import -k)")
     p.add_argument("--field-type", default="set", choices=["set", "int", "time"])
     p.add_argument("--field-min", type=int, default=0)
     p.add_argument("--field-max", type=int, default=0)

@@ -270,6 +270,23 @@ def test_import_with_keys(tmp_path, server):
     assert resp["results"][0][0]["count"] == 2
 
 
+def test_import_k_shorthand(tmp_path, server):
+    """-k = --index-keys --field-keys (the reference's import -k)."""
+    csv_path = tmp_path / "k.csv"
+    csv_path.write_text("likes,alice\nlikes,bob\n")
+    rc = main([
+        "import", "--host", f"localhost:{server.port}",
+        "-i", "impk2", "-f", "kf", "--create", "-k", str(csv_path),
+    ])
+    assert rc == 0
+    from pilosa_tpu.server.client import InternalClient
+
+    resp = InternalClient().query(
+        f"localhost:{server.port}", "impk2", 'Count(Row(kf="likes"))'
+    )
+    assert resp["results"][0] == 2
+
+
 def test_import_int_field_with_keys(tmp_path, server):
     csv_path = tmp_path / "kv.csv"
     csv_path.write_text("alice,42\nbob,58\n")
