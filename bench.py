@@ -31,9 +31,11 @@ BENCH_HBM_GIB (resident-stack size for the bandwidth stanza; default 8 on
 TPU / 0.125 on CPU), BENCH_BIG_{SHARDS,ROWS,ITERS} (HBM-resident headline
 stanza; default 256x128 = 4 GiB on TPU / 16x32 on CPU),
 BENCH_CHILD_MIN_S (minimum window worth handing to a TPU child, default
-420), and BENCH_{HBM,BIG,SCALE,OPEN,IMPORT,SERVING,TOPN_BSI,TIME_RANGE}=0
+420), and
+BENCH_{HBM,BIG,SCALE,OPEN,IMPORT,SERVING,SCHED,TOPN_BSI,TIME_RANGE}=0
 to skip a stanza (the Pallas-vs-XLA kernel race lives inside the HBM
-stanza).
+stanza; SCHED measures the query scheduler's cross-query micro-batching
+— dispatches/query with >= 8 concurrent clients).
 """
 
 import json
@@ -762,6 +764,93 @@ def bench_serving():
     return out
 
 
+# --------------------------------------------- scheduler/coalescing stanza
+
+
+def bench_sched():
+    """Concurrent clients through the query scheduler's micro-batcher:
+    dispatches/query for >= 8 simultaneous same-shape Count queries over
+    one resident stack (the ISSUE-1 acceptance metric), plus qps with the
+    batch window on vs. off. Unlike the r5-removed transparent coalescer,
+    the batcher holds a dispatch ONLY under concurrent pressure (a lone
+    query pays zero added latency), so the win condition is fewer engine
+    launches per query at equal-or-better qps. The result memo is off so
+    every request would otherwise be its own device dispatch."""
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.sched import SchedulerConfig
+    from pilosa_tpu.server.client import InternalClient
+    from pilosa_tpu.server.server import Server
+
+    n_rows, n_clients, per_client = 16, 16, 16
+    rng = np.random.default_rng(23)
+    out = {}
+    prev_memo = os.environ.get("PILOSA_MEMO_ENTRIES")
+    os.environ["PILOSA_MEMO_ENTRIES"] = "0"
+    try:
+        for label, window_max in (("batch_off", 0.0), ("batch_on", 0.002)):
+            s = Server(
+                cache_flush_interval=0, member_monitor_interval=0,
+                scheduler_config=SchedulerConfig(
+                    interactive_concurrency=n_clients,
+                    batch_window=0.0005, batch_window_max=window_max,
+                ),
+            )
+            s.open()
+            try:
+                idx = s.holder.create_index("sched")
+                fld = idx.create_field("f")
+                rows, cols = [], []
+                for row in range(n_rows):
+                    c = rng.choice(SHARD_WIDTH, size=2048, replace=False)
+                    rows.append(np.full(2048, row, dtype=np.uint64))
+                    cols.append(c.astype(np.uint64))
+                fld.import_bits(np.concatenate(rows), np.concatenate(cols))
+                h = f"localhost:{s.port}"
+
+                def worker(wid):
+                    local = InternalClient()
+                    for i in range(per_client):
+                        local.query(
+                            h, "sched", f"Count(Row(f={(wid + i) % n_rows}))")
+
+                with ThreadPoolExecutor(max_workers=n_clients) as pool:
+                    list(pool.map(worker, range(n_clients)))  # warm/compile
+                with urllib.request.urlopen(f"http://{h}/debug/vars") as r:
+                    before = json.load(r)["engine_cache"]["count_dispatches"]
+                t0 = time.perf_counter()
+                with ThreadPoolExecutor(max_workers=n_clients) as pool:
+                    list(pool.map(worker, range(n_clients)))
+                elapsed = time.perf_counter() - t0
+                with urllib.request.urlopen(f"http://{h}/debug/vars") as r:
+                    dv = json.load(r)
+                n_q = n_clients * per_client
+                dpq = (dv["engine_cache"]["count_dispatches"] - before) / n_q
+                out[label] = {
+                    "qps": round(n_q / elapsed, 1),
+                    "dispatches_per_query": round(dpq, 3),
+                }
+                if label == "batch_on":
+                    out[label]["batcher"] = dv.get("batcher", {})
+            finally:
+                s.close()
+    finally:
+        # Restore (not pop): a user-exported memo size must still govern
+        # the stanzas that run after this one.
+        if prev_memo is None:
+            os.environ.pop("PILOSA_MEMO_ENTRIES", None)
+        else:
+            os.environ["PILOSA_MEMO_ENTRIES"] = prev_memo
+    if "batch_on" in out and "batch_off" in out:
+        out["coalesced_ok"] = out["batch_on"]["dispatches_per_query"] < 1.0
+        off = out["batch_off"]["qps"]
+        if off:
+            out["qps_ratio"] = round(out["batch_on"]["qps"] / off, 2)
+    return out
+
+
 # ------------------------------------------------------- import stanza
 
 
@@ -1304,6 +1393,7 @@ def main():
     open_stanza = stanza("OPEN", bench_open)
     import_stanza = stanza("IMPORT", bench_import)
     serving = stanza("SERVING", bench_serving)
+    stanza("SCHED", bench_sched)
     topn_bsi = stanza("TOPN_BSI", bench_topn_bsi)
     time_range = stanza("TIME_RANGE", bench_time_range)
 

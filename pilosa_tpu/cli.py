@@ -47,6 +47,21 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--gossip-probe-timeout", dest="gossip_probe_timeout", type=float)
     p.add_argument("--gossip-key", dest="gossip_key",
                    help="path to cluster shared-secret file")
+    p.add_argument("--sched-max-queue", dest="sched_max_queue", type=int,
+                   help="bounded admission queue; full requests get 429")
+    p.add_argument("--sched-interactive-concurrency",
+                   dest="sched_interactive_concurrency", type=int)
+    p.add_argument("--sched-batch-concurrency",
+                   dest="sched_batch_concurrency", type=int)
+    p.add_argument("--sched-default-deadline", dest="sched_default_deadline",
+                   type=float, help="default per-query budget in seconds (0 = none)")
+    p.add_argument("--sched-retry-after", dest="sched_retry_after", type=float)
+    p.add_argument("--sched-batch-window", dest="sched_batch_window", type=float,
+                   help="micro-batch base window in seconds")
+    p.add_argument("--sched-batch-window-max", dest="sched_batch_window_max",
+                   type=float)
+    p.add_argument("--sched-batch-max", dest="sched_batch_max", type=int,
+                   help="max queries coalesced into one device launch")
     p.add_argument("--translation-primary-url", dest="translation_primary_url")
     p.add_argument("--tls-certificate", dest="tls_certificate")
     p.add_argument("--tls-certificate-key", dest="tls_certificate_key")

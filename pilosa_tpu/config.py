@@ -8,7 +8,12 @@ TOML parsing uses stdlib tomllib.
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: the baked-in tomli backport
+    import tomli as tomllib
+
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -52,6 +57,12 @@ class GossipConfig:
     key: str = ""  # path to shared-secret file; empty = open cluster
 
 
+# The [scheduler] section IS the scheduler's own dataclass — one source
+# of truth for knob names and defaults (a config-side copy would drift).
+# See docs/scheduler.md for how the knobs interact.
+from .sched import SchedulerConfig as SchedConfig  # noqa: E402
+
+
 @dataclass
 class MetricConfig:
     service: str = "inmem"  # inmem | nop
@@ -88,6 +99,7 @@ class Config:
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     gossip: GossipConfig = field(default_factory=GossipConfig)
+    scheduler: SchedConfig = field(default_factory=SchedConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     translation: TranslationConfig = field(default_factory=TranslationConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
@@ -126,6 +138,19 @@ class Config:
         self.gossip.probe_timeout = g.get("probe-timeout", self.gossip.probe_timeout)
         self.gossip.failover_probes = g.get("failover-probes", self.gossip.failover_probes)
         self.gossip.key = g.get("key", self.gossip.key)
+        s = d.get("scheduler", {})
+        self.scheduler.max_queue = s.get("max-queue", self.scheduler.max_queue)
+        self.scheduler.interactive_concurrency = s.get(
+            "interactive-concurrency", self.scheduler.interactive_concurrency)
+        self.scheduler.batch_concurrency = s.get(
+            "batch-concurrency", self.scheduler.batch_concurrency)
+        self.scheduler.default_deadline = s.get(
+            "default-deadline", self.scheduler.default_deadline)
+        self.scheduler.retry_after = s.get("retry-after", self.scheduler.retry_after)
+        self.scheduler.batch_window = s.get("batch-window", self.scheduler.batch_window)
+        self.scheduler.batch_window_max = s.get(
+            "batch-window-max", self.scheduler.batch_window_max)
+        self.scheduler.batch_max = s.get("batch-max", self.scheduler.batch_max)
         m = d.get("metric", {})
         self.metric.service = m.get("service", self.metric.service)
         self.metric.host = m.get("host", self.metric.host)
@@ -182,6 +207,19 @@ class Config:
             v = env(name, cast)
             if v is not None:
                 setattr(self.gossip, attr, v)
+        for attr, name, cast in [
+            ("max_queue", "SCHED_MAX_QUEUE", int),
+            ("interactive_concurrency", "SCHED_INTERACTIVE_CONCURRENCY", int),
+            ("batch_concurrency", "SCHED_BATCH_CONCURRENCY", int),
+            ("default_deadline", "SCHED_DEFAULT_DEADLINE", float),
+            ("retry_after", "SCHED_RETRY_AFTER", float),
+            ("batch_window", "SCHED_BATCH_WINDOW", float),
+            ("batch_window_max", "SCHED_BATCH_WINDOW_MAX", float),
+            ("batch_max", "SCHED_BATCH_MAX", int),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.scheduler, attr, v)
         v = env("TRANSLATION_PRIMARY_URL", str)
         if v is not None:
             self.translation.primary_url = v
@@ -213,6 +251,14 @@ class Config:
             "gossip_probe_timeout": ("gossip", "probe_timeout"),
             "gossip_failover_probes": ("gossip", "failover_probes"),
             "gossip_key": ("gossip", "key"),
+            "sched_max_queue": ("scheduler", "max_queue"),
+            "sched_interactive_concurrency": ("scheduler", "interactive_concurrency"),
+            "sched_batch_concurrency": ("scheduler", "batch_concurrency"),
+            "sched_default_deadline": ("scheduler", "default_deadline"),
+            "sched_retry_after": ("scheduler", "retry_after"),
+            "sched_batch_window": ("scheduler", "batch_window"),
+            "sched_batch_window_max": ("scheduler", "batch_window_max"),
+            "sched_batch_max": ("scheduler", "batch_max"),
             "translation_primary_url": ("translation", "primary_url"),
             "tls_certificate": ("tls", "certificate_path"),
             "tls_certificate_key": ("tls", "certificate_key_path"),
@@ -261,6 +307,16 @@ class Config:
             f"probe-timeout = {self.gossip.probe_timeout}",
             f"failover-probes = {self.gossip.failover_probes}",
             f"key = {fmt(self.gossip.key)}",
+            "",
+            "[scheduler]",
+            f"max-queue = {self.scheduler.max_queue}",
+            f"interactive-concurrency = {self.scheduler.interactive_concurrency}",
+            f"batch-concurrency = {self.scheduler.batch_concurrency}",
+            f"default-deadline = {self.scheduler.default_deadline}",
+            f"retry-after = {self.scheduler.retry_after}",
+            f"batch-window = {self.scheduler.batch_window}",
+            f"batch-window-max = {self.scheduler.batch_window_max}",
+            f"batch-max = {self.scheduler.batch_max}",
             "",
             "[metric]",
             f"service = {fmt(self.metric.service)}",
@@ -313,6 +369,7 @@ class Config:
             member_probe_timeout=self.gossip.probe_timeout,
             coordinator_failover_probes=self.gossip.failover_probes,
             internal_key_path=self.gossip.key or None,
+            scheduler_config=self.scheduler,
         )
         kw.update(overrides)
         return Server(**kw)

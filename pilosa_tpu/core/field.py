@@ -133,6 +133,7 @@ class Field:
         stats=None,
         broadcast_shard=None,
         use_sqlite_attrs: bool = True,
+        epoch=None,
     ):
         validate_name(name)
         self.path = path
@@ -141,6 +142,7 @@ class Field:
         self.options = options or FieldOptions()
         self.stats = stats
         self.broadcast_shard = broadcast_shard
+        self.epoch = epoch
         self.views: Dict[str, View] = {}
         self.bsi_groups: List[BSIGroup] = []
         self._lock = threading.RLock()
@@ -214,6 +216,7 @@ class Field:
             row_attr_store=self.row_attr_store,
             stats=self.stats,
             broadcast_shard=self.broadcast_shard,
+            epoch=self.epoch,
         )
 
     def view(self, name: str) -> Optional[View]:

@@ -64,6 +64,27 @@ def _block_hash(positions: np.ndarray) -> bytes:
     return h.digest()
 
 
+class WriteEpoch:
+    """Monotonic per-index write counter, bumped by every fragment
+    mutation in the index. O(1) to read, so serving-path layers (the
+    query micro-batcher's group key, /debug/vars) can ask "has ANYTHING
+    in this index changed?" without walking per-fragment generations.
+    Locked: an unlocked += can regress under a read-stall-write race
+    (load 5, preempt through 95 bumps, store 6), and a regressed epoch
+    could collide a batch key with one seen before a write burst. Reads
+    are a bare attribute load — a torn read is impossible for an int."""
+
+    __slots__ = ("value", "_mu")
+
+    def __init__(self):
+        self.value = 0
+        self._mu = threading.Lock()
+
+    def bump(self) -> None:
+        with self._mu:
+            self.value += 1
+
+
 @dataclass
 class FragmentBlock:
     id: int
@@ -99,6 +120,7 @@ class Fragment:
         row_attr_store=None,
         stats=None,
         max_op_n: int = MAX_OP_N,
+        epoch: Optional[WriteEpoch] = None,
     ):
         self.path = path
         self.index = index
@@ -128,6 +150,9 @@ class Fragment:
         # Bumped on every mutation; lets the sharded query engine know when
         # its device-resident leaf tensors are stale (parallel/engine.py).
         self.generation = 0
+        # Index-level write epoch (see WriteEpoch), bumped alongside
+        # generation so O(1) index staleness reads need no fragment walk.
+        self.epoch = epoch
 
     # ---------------------------------------------------------------- open
 
@@ -222,6 +247,8 @@ class Fragment:
         self._plane_cache.pop(row_id, None)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         self.generation += 1
+        if self.epoch is not None:
+            self.epoch.bump()
 
     def set_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
@@ -758,6 +785,8 @@ class Fragment:
             self._checksums.clear()
             self.cache.clear()
             self.generation += 1
+            if self.epoch is not None:
+                self.epoch.bump()
             for row_id in self.rows():
                 self.cache.bulk_add(row_id, self.row_count(row_id))
             self.cache.invalidate(force=True)

@@ -46,6 +46,13 @@ class Index:
         self.options = options or IndexOptions()
         self.stats = stats
         self.broadcast_shard = broadcast_shard
+        # Index-wide write epoch: every fragment mutation in this index
+        # bumps it (core/fragment.py WriteEpoch). The query micro-batcher
+        # keys coalescing groups on it so a batch never mixes queries
+        # spanning a visible write boundary.
+        from .fragment import WriteEpoch
+
+        self.write_epoch = WriteEpoch()
         self.fields: Dict[str, Field] = {}
         # Highest shard known to exist cluster-wide, even if not held
         # locally (reference index.go:231-255 remoteMaxShard, synced via
@@ -74,6 +81,7 @@ class Index:
                 field = Field(
                     fpath, self.name, fname, stats=self.stats,
                     broadcast_shard=self.broadcast_shard,
+                    epoch=self.write_epoch,
                 )
                 field.open()
                 self.fields[fname] = field
@@ -119,6 +127,7 @@ class Index:
             options=options,
             stats=self.stats,
             broadcast_shard=self.broadcast_shard,
+            epoch=self.write_epoch,
         )
         field.open()
         field.save_meta()

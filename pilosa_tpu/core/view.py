@@ -28,6 +28,7 @@ class View:
         row_attr_store=None,
         stats=None,
         broadcast_shard: Optional[Callable[[str, str, int], None]] = None,
+        epoch=None,
     ):
         self.path = path
         self.index = index
@@ -38,6 +39,7 @@ class View:
         self.row_attr_store = row_attr_store
         self.stats = stats
         self.broadcast_shard = broadcast_shard
+        self.epoch = epoch
         self.fragments: Dict[int, Fragment] = {}
         self._lock = threading.RLock()
 
@@ -75,6 +77,7 @@ class View:
             cache_size=self.cache_size,
             row_attr_store=self.row_attr_store,
             stats=self.stats,
+            epoch=self.epoch,
         )
 
     def fragment(self, shard: int) -> Optional[Fragment]:

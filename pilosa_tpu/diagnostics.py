@@ -66,6 +66,19 @@ class DiagnosticsCollector:
             "clusterState": self.server.cluster.state,
             "nodeID": self.server.cluster.node.id,
         }
+        # Scheduler shape (non-sensitive aggregates): shed/admit totals say
+        # whether a deployment is sized right for its load.
+        scheduler = getattr(self.server, "scheduler", None)
+        if scheduler is not None:
+            snap = scheduler.snapshot()
+            info["schedAdmitted"] = snap.get("admitted", 0)
+            info["schedShed"] = snap.get("shed", 0)
+            info["schedDeadlineExceeded"] = snap.get("deadline_exceeded", 0)
+        batcher = getattr(self.server, "batcher", None)
+        if batcher is not None:
+            snap = batcher.snapshot()
+            info["schedBatchLaunches"] = snap.get("launches", 0)
+            info["schedBatchCoalesced"] = snap.get("coalesced", 0)
         info.update(system_info())
         info.update(self._extra)
         return info

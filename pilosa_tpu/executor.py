@@ -36,17 +36,20 @@ DEFAULT_MIN_THRESHOLD = 1
 
 def _topn_chunk(n_shards: int) -> int:
     """Candidate rows per TopN device program, bounded by BYTES not rows:
-    a fixed 512-row chunk is 256 MiB at 8 shards but 16 GiB at 256 shards
+    a fixed 512-row chunk is 512 MiB at 8 shards but 16 GiB at 256 shards
     (each row costs n_shards * 128 KiB in the stacked tensor). The byte
     budget (PILOSA_TOPN_CHUNK_BYTES, default 2 GiB) trades dispatches per
     TopN against stacked-tensor working set; row counts pad to pow2 in the
-    engine so varied chunk sizes reuse compiled programs."""
+    engine so varied chunk sizes reuse compiled programs. The floor is ONE
+    row (not a fixed 16): at extreme shard counts even 16 rows overruns
+    the budget (16 rows x 4096 shards x 128 KiB = 8 GiB), and a single
+    row per program is the smallest dispatch that still makes progress."""
     import os
 
     from .constants import WORDS_PER_ROW
 
     budget = int(os.environ.get("PILOSA_TOPN_CHUNK_BYTES", 2 << 30))
-    return max(16, min(512, budget // max(1, n_shards * WORDS_PER_ROW * 4)))
+    return max(1, min(512, budget // max(1, n_shards * WORDS_PER_ROW * 4)))
 
 _WRITE_CALLS = {"Set", "Clear", "SetValue", "SetRowAttrs", "SetColumnAttrs"}
 
@@ -55,8 +58,12 @@ def _is_node_failure(e) -> bool:
     """True when a ClientError indicates the NODE failed (connect/transport
     error carries status 0, server fault is 5xx) rather than the REQUEST
     (4xx application errors are deterministic: the peer is healthy and
-    every replica would answer the same)."""
+    every replica would answer the same). A deadline-expiry 503 is the
+    REQUEST's budget running out on a healthy peer — one client's tight
+    deadline must not mark nodes unavailable and poison routing."""
     status = getattr(e, "status", 0)
+    if status == 503 and "deadline exceeded" in str(e):
+        return False
     return status == 0 or status >= 500
 
 
@@ -66,6 +73,11 @@ class ExecOptions:
     exclude_row_attrs: bool = False
     exclude_columns: bool = False
     column_attrs: bool = False
+    # Per-request time budget (sched/deadline.py), installed at admission.
+    # Checked before every device dispatch and every remote fan-out hop so
+    # an expired query stops consuming device time instead of pinning
+    # threads; the REMAINING budget rides forwarded requests' headers.
+    deadline: Optional[Any] = None
 
 
 @dataclass
@@ -111,6 +123,11 @@ class Executor:
         self.max_writes_per_request = max_writes_per_request
         self._pool = ThreadPoolExecutor(max_workers=workers) if workers > 1 else None
         self._engine = None  # lazy ShardedQueryEngine
+        # Cross-query micro-batcher (sched/batcher.py), wired by the
+        # server's scheduler. When present, compatible local count
+        # dispatches coalesce into one fused engine launch; None keeps the
+        # direct single-query engine path (library/embedded use).
+        self.batcher = None
         # Multi-host collective backend (parallel/collective.py), wired by
         # the server. When a jax.distributed job spans the cluster, full-
         # index fast-path queries run as ONE SPMD program over the global
@@ -255,11 +272,20 @@ class Executor:
         device, remote nodes get one batched query. Failed nodes are marked
         and their shards re-mapped onto replicas (executor.go:1464-1555)."""
 
+        deadline = opt.deadline
+
+        def checked_map(shard):
+            # Per-shard deadline gate: mid-map-reduce expiry aborts before
+            # the NEXT shard's work rather than draining the whole list.
+            if deadline is not None:
+                deadline.check("shard map")
+            return map_fn(shard)
+
         def local_runner(local_shards):
             if self._pool is not None and len(local_shards) > 1:
-                values = list(self._pool.map(map_fn, local_shards))
+                values = list(self._pool.map(checked_map, local_shards))
             else:
-                values = [map_fn(s) for s in local_shards]
+                values = [checked_map(s) for s in local_shards]
             result = None
             for v in values:
                 result = v if result is None else reduce_fn(result, v)
@@ -293,6 +319,8 @@ class Executor:
                 raise
             pending = []
             if local:
+                if opt.deadline is not None:
+                    opt.deadline.check("local dispatch")
                 v = local_runner(local)
                 if v is not None:
                     result = v if result is None else reduce_fn(result, v)
@@ -300,11 +328,27 @@ class Executor:
                 if opt.remote:
                     continue  # remote calls are restricted to local shards
                 node = self.cluster.node_by_id(node_id)
+                kw = {}
+                if opt.deadline is not None:
+                    # Abort before the hop, and forward only the REMAINING
+                    # budget so the peer never works past our cutoff. The
+                    # kwarg rides only when a deadline exists, so duck-typed
+                    # test clients without the parameter keep working.
+                    opt.deadline.check("remote fan-out")
+                    kw["deadline"] = opt.deadline.remaining()
                 try:
                     v = self.client.query_node(
-                        node, index, str(c), shards=node_shards, remote=True
+                        node, index, str(c), shards=node_shards, remote=True,
+                        **kw,
                     )[0]
                 except ClientError as e:
+                    if opt.deadline is not None and opt.deadline.expired():
+                        # The peer failed while OUR budget ran out (its
+                        # forwarded budget is a slice of ours, so a peer
+                        # expiry implies ours): abort cleanly as a deadline
+                        # miss instead of spending the corpse of the budget
+                        # chasing replicas or re-marking healthy nodes.
+                        opt.deadline.check("remote fan-out")
                     if not _is_node_failure(e):
                         # 4xx: the peer executed and rejected the query.
                         # The node is healthy — do NOT mark it unavailable —
@@ -509,7 +553,15 @@ class Executor:
             compiled = None if supported is True else supported
 
             def local_runner(local_shards):
+                if opt.deadline is not None:
+                    # "Aborts before the next device dispatch": the gate
+                    # sits exactly at the engine-launch boundary.
+                    opt.deadline.check("device dispatch")
                 if kind == "count":
+                    if self.batcher is not None:
+                        return self.batcher.count(
+                            index, target, local_shards, comp_expr=compiled,
+                            deadline=opt.deadline)
                     return self.engine.count(
                         index, target, local_shards, comp_expr=compiled)
                 return self.engine.bitmap(

@@ -281,7 +281,23 @@ class ShardedQueryEngine:
             "leaf_hits": 0, "leaf_misses": 0, "leaf_evictions": 0,
             "stack_hits": 0, "stack_misses": 0, "stack_evictions": 0,
             "memo_hits": 0, "memo_misses": 0,
+            # Device-program launches (memo hits dispatch nothing). The
+            # scheduler's coalescing proof is dispatches/query < 1, so the
+            # counters must distinguish a launch from an answered query.
+            "count_dispatches": 0, "bitmap_dispatches": 0,
         }
+
+    def stack_generation(self, index: str) -> int:
+        """O(1) write epoch of an index's resident leaf stacks (bumped by
+        every fragment mutation, core/fragment.py WriteEpoch). The micro-
+        batcher keys coalescing groups on it so one fused launch never
+        mixes queries that straddle a visible write."""
+        idx = self.holder.index(index)
+        return -1 if idx is None else idx.write_epoch.value
+
+    def _count_dispatch(self) -> None:
+        with self._lock:
+            self.counters["count_dispatches"] += 1
 
     # ------------------------------------------------------------ caches
     #
@@ -575,6 +591,7 @@ class ShardedQueryEngine:
 
         fn = self._fn_build(self._count_fns, sig, build)
         leaves = self._leaf_tensor(index, comp.leaves, shards)
+        self._count_dispatch()
         result = int(fn(leaves))
         self.memo_store(token, result)
         return result
@@ -599,9 +616,12 @@ class ShardedQueryEngine:
             return fn
 
         fn = self._fn_build(self._count_fns, sig, build)
-        return fn(self._leaf_tensor(index, comp.leaves, shards))
+        leaves = self._leaf_tensor(index, comp.leaves, shards)
+        self._count_dispatch()
+        return fn(leaves)
 
-    def count_batch(self, index: str, calls: Sequence[Call], shards: Sequence[int]) -> np.ndarray:
+    def count_batch(self, index: str, calls: Sequence[Call], shards: Sequence[int],
+                    comps=None) -> np.ndarray:
         """Count Q structurally-identical queries in ONE device program.
 
         Every bitplane op is elementwise, so the compiled expression applies
@@ -609,10 +629,13 @@ class ShardedQueryEngine:
         host pays one dispatch + one transfer for Q results. This is the
         throughput-serving path (amortizes host<->device latency that caps
         per-call serving at ~1/RTT). Queries answered by the result memo
-        skip the device entirely; only misses ride the batched program."""
+        skip the device entirely; only misses ride the batched program.
+        `comps` skips recompiling already-compiled calls (aligned 1:1 with
+        `calls` — the micro-batcher compiled each query at enqueue)."""
         shards = tuple(shards)
-        fcache: Dict = {}
-        comps = [self._compile(index, c, field_cache=fcache) for c in calls]
+        if comps is None:
+            fcache: Dict = {}
+            comps = [self._compile(index, c, field_cache=fcache) for c in calls]
         out = np.empty(len(calls), dtype=np.int64)
         miss = []
         tokens = {}
@@ -684,6 +707,7 @@ class ShardedQueryEngine:
         leavess = tuple(
             self._leaf_tensor(index, comp.leaves, shards) for comp, _ in comps
         )
+        self._count_dispatch()
         return fn(leavess)
 
     def _count_batch_setops(self, index: str, comps, shards: Tuple[int, ...],
@@ -799,6 +823,7 @@ class ShardedQueryEngine:
             return fn
 
         fn = self._fn_build(self._count_fns, sig, build)
+        self._count_dispatch()
         if inv_in is not None:
             return fn(stacked, idxs, inv_in)
         return fn(stacked, idxs)
@@ -829,6 +854,8 @@ class ShardedQueryEngine:
         sig = ("bitmap", tuple(comp.signature), len(shards))
         fn = self._fn_build(self._bitmap_fns, sig, lambda: jax.jit(expr))
         leaves = self._leaf_tensor(index, comp.leaves, shards)
+        with self._lock:
+            self.counters["bitmap_dispatches"] += 1
         planes = fn(leaves)  # (S_padded, W) sharded
         return Row({shard: planes[i] for i, shard in enumerate(shards)})
 

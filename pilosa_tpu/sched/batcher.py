@@ -1,0 +1,212 @@
+"""Cross-query micro-batcher: coalesce compatible device dispatches.
+
+The engine can already count Q structurally-identical queries in ONE
+device program (parallel/engine.py count_batch) — but only a single
+caller ever used it. Under concurrent serving, N independent HTTP
+threads each launched their own program over the SAME resident leaf
+stack, paying N dispatches and N host<->device round trips for work one
+fused (U, S, W) pass amortizes (the kernels are HBM-bandwidth-bound, so
+the memory traffic dominates).
+
+This batcher holds a count dispatch for a short window and coalesces
+every compatible request that arrives meanwhile:
+
+  - compatibility key: (index, shard set, structure signature, index
+    write epoch) — same leaf stack, same compiled program shape, same
+    stack generation, so the fused launch is byte-identical to running
+    each query alone at that instant;
+  - the FIRST arrival becomes the group leader: it waits the window,
+    then takes the group and runs one engine.count_batch launch,
+    splitting the (Q,) result back per caller; followers just wait on
+    their slot;
+  - the window adapts to load: with <= 1 query in flight there is nobody
+    to coalesce with, so the dispatch goes out immediately (zero added
+    latency for a lone client); under concurrency it grows with queue
+    depth between window and window_max (~0.5-2 ms by default);
+  - a group that reaches batch_max closes AND launches early (the filler
+    signals the leader's window event) — a group as large as it can get
+    must not sit out the rest of its window; the next arrival starts a
+    new group.
+
+`wait_window` is injectable so tests drive the window deterministically;
+the default waits on the group's full-event with the window as timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .deadline import Deadline
+
+
+class _Item:
+    __slots__ = ("call", "comp_expr", "event", "result", "error")
+
+    def __init__(self, call, comp_expr):
+        self.call = call
+        self.comp_expr = comp_expr
+        self.event = threading.Event()
+        self.result: Optional[int] = None
+        self.error: Optional[BaseException] = None
+
+
+class _Group:
+    __slots__ = ("items", "closed", "full")
+
+    def __init__(self):
+        self.items: List[_Item] = []
+        self.closed = False
+        # Set when the group fills to batch_max: wakes the leader out of
+        # its window so a maxed-out batch launches immediately.
+        self.full = threading.Event()
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        get_engine: Callable[[], object],
+        window: float = 0.0005,
+        window_max: float = 0.002,
+        batch_max: int = 64,
+        depth_fn: Optional[Callable[[], int]] = None,
+        stats=None,
+        wait_window: Optional[Callable[["_Group", float], None]] = None,
+    ):
+        # Lazy engine access: the executor's engine initializes on first
+        # device use, and constructing the batcher must not be the thing
+        # that first opens a (possibly dead) TPU tunnel.
+        self.get_engine = get_engine
+        self.window = window
+        self.window_max = window_max
+        self.batch_max = max(1, batch_max)
+        # In-flight pressure signal (scheduler queue depth + running); the
+        # window only opens when there is somebody to coalesce with.
+        self.depth_fn = depth_fn
+        self.stats = stats
+        if wait_window is not None:
+            self.wait_window = wait_window
+        self._lock = threading.Lock()
+        self._pending: Dict[tuple, _Group] = {}
+        self.counters: Dict[str, int] = {
+            "enqueued": 0, "launches": 0, "coalesced": 0, "fallbacks": 0,
+        }
+
+    # ------------------------------------------------------------- window
+
+    def effective_window(self) -> float:
+        """Seconds to hold a dispatch open, adapted to load. 0 when
+        batching is disabled or nothing else is in flight."""
+        if self.window_max <= 0 or self.window <= 0:
+            return 0.0
+        depth = self.depth_fn() if self.depth_fn is not None else 0
+        if depth <= 1:
+            return 0.0  # lone query: nobody to wait for
+        return min(self.window_max, self.window * depth)
+
+    def wait_window(self, group: "_Group", window: float) -> None:
+        """Leader's hold: sleeps the window OR returns the moment the
+        group fills to batch_max (whichever comes first). Overridable for
+        deterministic tests."""
+        group.full.wait(timeout=window)
+
+    # -------------------------------------------------------------- count
+
+    def count(self, index: str, call, shards, comp_expr=None,
+              deadline: Optional[Deadline] = None) -> int:
+        """Count(call) over `shards`, coalesced with any compatible
+        concurrent request. Results are byte-identical to the unbatched
+        engine path (count_batch shares the memo and the count program)."""
+        engine = self.get_engine()
+        window = self.effective_window()
+        if window <= 0:
+            return engine.count(index, call, shards, comp_expr=comp_expr)
+        if comp_expr is None or comp_expr is True:
+            comp_expr = engine._compile(index, call)
+        comp, _ = comp_expr
+        shards = tuple(shards)
+        # Memo hits answer NOW: a repeat hot query is a dict lookup, and
+        # parking it in a window group would turn microseconds into
+        # milliseconds under concurrency. Only memo misses — the queries
+        # that actually need a device launch — are worth coalescing.
+        hit, _ = engine.memo_probe(index, comp, shards)
+        if hit is not None:
+            return hit
+        key = (
+            index, shards, tuple(comp.signature),
+            engine.stack_generation(index),
+        )
+        item = _Item(call, comp_expr)
+        with self._lock:
+            group = self._pending.get(key)
+            leader = group is None or group.closed
+            if leader:
+                group = _Group()
+                self._pending[key] = group
+            group.items.append(item)
+            self.counters["enqueued"] += 1
+            if len(group.items) >= self.batch_max:
+                # Close early AND wake the leader: a group that can't grow
+                # must not sit out the rest of its window. New arrivals
+                # start a fresh group.
+                group.closed = True
+                if self._pending.get(key) is group:
+                    del self._pending[key]
+                group.full.set()
+        if leader:
+            self.wait_window(group, window)
+            self._run(key, group, engine, index, shards)
+        else:
+            # Leader wedged (device hang) or deadline pressure: fall back
+            # to a direct dispatch rather than parking forever. The bound
+            # is generous — the leader normally answers within the window
+            # plus one launch.
+            budget = 30.0
+            if deadline is not None:
+                budget = max(0.0, min(budget, deadline.remaining()))
+            if not item.event.wait(timeout=budget + 10 * self.window_max):
+                with self._lock:
+                    self.counters["fallbacks"] += 1
+                if deadline is not None:
+                    deadline.check("micro-batch wait")
+                return engine.count(index, call, shards,
+                                    comp_expr=item.comp_expr)
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def _run(self, key, group: _Group, engine, index: str, shards) -> None:
+        with self._lock:
+            if self._pending.get(key) is group:
+                del self._pending[key]
+            group.closed = True
+            items = list(group.items)
+        try:
+            if len(items) == 1:
+                results = [engine.count(index, items[0].call, shards,
+                                        comp_expr=items[0].comp_expr)]
+            else:
+                results = engine.count_batch(
+                    index, [it.call for it in items], shards,
+                    comps=[it.comp_expr for it in items],
+                )
+            for it, r in zip(items, results):
+                it.result = int(r)
+        except BaseException as e:
+            for it in items:
+                it.error = e
+        finally:
+            with self._lock:
+                self.counters["launches"] += 1
+                self.counters["coalesced"] += len(items) - 1
+            if self.stats:
+                self.stats.histogram("SchedulerBatchSize", len(items))
+            for it in items:
+                it.event.set()
+
+    # -------------------------------------------------------------- stats
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.counters)

@@ -1,0 +1,228 @@
+"""Admission control: bounded queue, per-class concurrency, load shedding.
+
+The per-process gate between the HTTP handler and the executor. Every
+query (and bulk import) is admitted before it may touch the device:
+
+  - a bounded WAITING queue per class — when a class's queue is full the
+    request is shed immediately with 429 + Retry-After instead of piling
+    another thread onto the compile gate / HBM contention;
+  - per-class concurrency limits so import/sync traffic (large, latency
+    tolerant) cannot starve interactive queries of executor slots, and
+    vice versa — the classes fail independently;
+  - wait bounded by the request's deadline: a query that spends its whole
+    budget queued is rejected without ever dispatching device work.
+
+All state is process-local (one scheduler per node); cross-node pressure
+propagates naturally because a shed coordinator returns 429 upstream.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from ..errors import PilosaError
+from .deadline import Deadline, DeadlineExceededError
+
+CLASS_INTERACTIVE = "interactive"
+CLASS_BATCH = "batch"
+
+
+class QueueFullError(PilosaError):
+    """Admission queue is full; the caller should retry after a backoff."""
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+@dataclass
+class SchedulerConfig:
+    # Bounded admission queue (waiters PER CLASS). 0 disables queueing
+    # entirely: anything beyond the concurrency limits sheds.
+    max_queue: int = 128
+    # Per-class executor concurrency. <= 0 means unlimited for that class.
+    interactive_concurrency: int = 8
+    batch_concurrency: int = 2
+    # Default per-request budget (seconds) when the client sends no
+    # X-Pilosa-Deadline header. 0 = no deadline.
+    default_deadline: float = 0.0
+    # Retry-After value (seconds) on 429 responses.
+    retry_after: float = 1.0
+    # Micro-batch window bounds (seconds) — see batcher.py. The effective
+    # window adapts to queue depth between these bounds; window_max = 0
+    # disables coalescing.
+    batch_window: float = 0.0005
+    batch_window_max: float = 0.002
+    # Max queries coalesced into one engine launch.
+    batch_max: int = 64
+
+
+class QueryScheduler:
+    """Admission gate + stats surface. One per server process."""
+
+    def __init__(self, config: Optional[SchedulerConfig] = None, stats=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config or SchedulerConfig()
+        self.stats = stats
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._waiting = 0  # total waiters across classes (observability)
+        self._waiting_by: Dict[str, int] = {}  # per-class: queue bound + pressure
+        self._running: Dict[str, int] = {}
+        # Forwarded (remote=True) sub-queries in flight: they bypass
+        # admission (the coordinator already admitted the query; re-
+        # admitting forms cross-node slot-wait cycles) but still count as
+        # coalescing pressure so data nodes open the micro-batch window.
+        self._remote_inflight = 0
+        self._sems: Dict[str, Optional[threading.BoundedSemaphore]] = {}
+        for cls, limit in (
+            (CLASS_INTERACTIVE, self.config.interactive_concurrency),
+            (CLASS_BATCH, self.config.batch_concurrency),
+        ):
+            self._sems[cls] = (
+                threading.BoundedSemaphore(limit) if limit > 0 else None
+            )
+            self._running[cls] = 0
+            self._waiting_by[cls] = 0
+        # Counters for /debug/vars (mirrors the engine's counters dict).
+        self.counters: Dict[str, int] = {
+            "admitted": 0, "shed": 0, "deadline_exceeded": 0,
+            "admitted_interactive": 0, "admitted_batch": 0,
+        }
+
+    # ---------------------------------------------------------- admission
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._waiting
+
+    def pressure(self, cls: Optional[str] = None) -> int:
+        """Requests in flight (waiting + running) — the micro-batcher's
+        signal for how long a dispatch is worth holding open: with <= 1 in
+        flight there is nobody to coalesce with. `cls` restricts BOTH
+        counts to one class; only coalescing-eligible traffic should open
+        the window (queued or running imports must not add latency to a
+        lone interactive query). Forwarded sub-queries count as
+        interactive pressure: on a data node they ARE the concurrent
+        count traffic worth coalescing, even though they skip admission."""
+        with self._lock:
+            if cls is not None:
+                n = self._waiting_by.get(cls, 0) + self._running.get(cls, 0)
+                if cls == CLASS_INTERACTIVE:
+                    n += self._remote_inflight
+                return n
+            return (self._waiting + sum(self._running.values())
+                    + self._remote_inflight)
+
+    @contextmanager
+    def track_remote(self):
+        """Count a forwarded sub-query as in-flight pressure WITHOUT
+        admission (no slot, no queue, never blocks, never sheds)."""
+        with self._lock:
+            self._remote_inflight += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._remote_inflight -= 1
+
+    def deadline_for(self, header_value: Optional[str]) -> Optional[Deadline]:
+        """Request Deadline from its header + the configured default."""
+        return Deadline.from_header(
+            header_value, self.config.default_deadline, clock=self.clock
+        )
+
+    @contextmanager
+    def admit(self, cls: str = CLASS_INTERACTIVE,
+              deadline: Optional[Deadline] = None):
+        """Admission gate. Raises QueueFullError (-> 429) when the waiting
+        queue is full, DeadlineExceededError when the budget expires while
+        queued. Holds a class concurrency slot for the body's duration."""
+        if cls not in self._sems:
+            cls = CLASS_INTERACTIVE
+        sem = self._sems[cls]
+        start = self.clock()
+        if deadline is not None and deadline.expired():
+            self._note_deadline("admission")
+        # Fast path: a free slot admits immediately without touching the
+        # queue, so max_queue bounds ACTUAL waiters (max_queue=0 means
+        # "never queue" — admit-or-shed — not "shed everything").
+        if sem is None or sem.acquire(blocking=False):
+            pass
+        else:
+            with self._lock:
+                # Queue space is bounded PER CLASS: a batch-import flood
+                # parking max_queue waiters must not eat the queue out
+                # from under interactive queries (the classes fail
+                # independently, queue included).
+                if self._waiting_by[cls] >= max(0, self.config.max_queue):
+                    self.counters["shed"] += 1
+                    if self.stats:
+                        self.stats.count("SchedulerShed", 1)
+                    raise QueueFullError(
+                        f"admission queue full ({self._waiting_by[cls]} "
+                        f"{cls} waiting); "
+                        f"retry after {self.config.retry_after:g}s",
+                        retry_after=self.config.retry_after,
+                    )
+                self._waiting += 1
+                self._waiting_by[cls] += 1
+                if self.stats:
+                    self.stats.gauge("SchedulerQueueDepth", self._waiting)
+            try:
+                # The semaphore wait runs on the REAL clock (an injected
+                # fake clock cannot preempt a blocked thread); the deadline
+                # bounds it so a saturated class rejects queued work at its
+                # budget instead of parking threads forever.
+                timeout = deadline.remaining() if deadline is not None else None
+                if not sem.acquire(timeout=timeout):
+                    self._note_deadline("admission wait")
+            finally:
+                with self._lock:
+                    self._waiting -= 1
+                    self._waiting_by[cls] -= 1
+        wait_ms = (self.clock() - start) * 1000.0
+        with self._lock:
+            self.counters["admitted"] += 1
+            self.counters[f"admitted_{cls}"] += 1
+            self._running[cls] += 1
+        if self.stats:
+            self.stats.histogram("SchedulerWaitMs", wait_ms)
+            self.stats.count("SchedulerAdmitted", 1)
+            self.stats.gauge(f"SchedulerRunning_{cls}", self._running[cls])
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._running[cls] -= 1
+            if sem is not None:
+                sem.release()
+
+    def _note_deadline(self, where: str) -> None:
+        self.note_deadline_exceeded()
+        err = DeadlineExceededError(f"query deadline exceeded at {where}")
+        err.counted = True  # already in scheduler stats; API must not recount
+        raise err
+
+    def note_deadline_exceeded(self) -> None:
+        """Record an expiry detected downstream (executor map/reduce or the
+        remote fan-out) so every abort is visible in scheduler stats."""
+        with self._lock:
+            self.counters["deadline_exceeded"] += 1
+        if self.stats:
+            self.stats.count("SchedulerDeadlineExceeded", 1)
+
+    # -------------------------------------------------------------- stats
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+            out["queue_depth"] = self._waiting
+            out["waiting"] = dict(self._waiting_by)
+            out["running"] = dict(self._running)
+            out["remote_inflight"] = self._remote_inflight
+        return out

@@ -83,15 +83,53 @@ class API:
         exclude_row_attrs: bool = False,
         exclude_columns: bool = False,
         remote: bool = False,
+        deadline=None,
+        traffic_class: Optional[str] = None,
     ) -> List[Any]:
+        """Execute PQL under the query scheduler's lifecycle: admit (429
+        when the queue is full) -> wait (bounded by `deadline`) ->
+        execute, with the deadline riding ExecOptions so the executor
+        aborts expired work before the next device dispatch. `deadline`
+        is a sched.Deadline (or None); `traffic_class` defaults to
+        interactive."""
         self._validate("query")
         opt = ExecOptions(
             remote=remote,
             column_attrs=column_attrs,
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns,
+            deadline=deadline,
         )
-        return self.executor.execute(index, query, shards=shards, opt=opt)
+        sched = getattr(self.server, "scheduler", None)
+        if sched is None:
+            return self.executor.execute(index, query, shards=shards, opt=opt)
+        from ..sched import CLASS_INTERACTIVE, DeadlineExceededError
+
+        try:
+            if remote:
+                # Remote (forwarded) sub-queries are fan-out fragments of
+                # a request the COORDINATOR already admitted — re-admitting
+                # them here would double-count the work and, when every
+                # node's interactive slots hold coordinators blocked on
+                # each other's peers, form a cross-node slot-wait cycle
+                # that only breaks on HTTP timeouts. Deadlines still apply
+                # via opt; backpressure belongs at the admission edge.
+                # They DO register as pressure, so concurrent fragment
+                # queries coalesce on data nodes too.
+                with sched.track_remote():
+                    return self.executor.execute(
+                        index, query, shards=shards, opt=opt)
+            with sched.admit(traffic_class or CLASS_INTERACTIVE, deadline):
+                return self.executor.execute(index, query, shards=shards, opt=opt)
+        except DeadlineExceededError as e:
+            # Expiries detected downstream (executor map/reduce, remote
+            # fan-out, micro-batch wait) surface here — on forwarded
+            # sub-queries too; count each once so every abort is
+            # observable in scheduler stats.
+            if not getattr(e, "counted", False):
+                e.counted = True
+                sched.note_deadline_exceeded()
+            raise
 
     def query_response(self, index: str, query: str, **kw) -> Dict[str, Any]:
         """Query + serialize results to the JSON wire shape

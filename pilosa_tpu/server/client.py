@@ -87,11 +87,16 @@ class InternalClient:
     IDLE_REUSE_S = 20.0
 
     def _conn(self, scheme: str, netloc: str):
-        """Per-thread keep-alive connection to `netloc`. urllib opens a
-        fresh TCP connection per request, which put ~0.7 ms of setup on
-        every node-to-node call (fan-out, replication, heartbeats);
-        pooled HTTP/1.1 connections cut a serial query round trip ~2x.
-        Thread-local, so no cross-thread sharing of http.client state."""
+        """Per-thread keep-alive connection to `netloc`, returned as
+        (conn, fresh). urllib opens a fresh TCP connection per request,
+        which put ~0.7 ms of setup on every node-to-node call (fan-out,
+        replication, heartbeats); pooled HTTP/1.1 connections cut a serial
+        query round trip ~2x. Thread-local, so no cross-thread sharing of
+        http.client state. `fresh` is True when the connection was just
+        opened — the retry policy needs to know, because only on a fresh
+        connection does a send-phase error prove the peer never saw the
+        request (a pooled connection's close race can deliver a partial
+        body the peer may have already acted on)."""
         pool = getattr(self._local, "conns", None)
         if pool is None:
             pool = self._local.conns = {}
@@ -99,7 +104,7 @@ class InternalClient:
         if entry is not None:
             conn, last_used = entry
             if time.monotonic() - last_used < self.IDLE_REUSE_S:
-                return conn
+                return conn, False
             conn.close()
             del pool[(scheme, netloc)]
         if scheme == "https":
@@ -115,7 +120,7 @@ class InternalClient:
         # per round trip on the delayed-ACK interaction.
         conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         pool[(scheme, netloc)] = (conn, time.monotonic())
-        return conn
+        return conn, True
 
     def _touch_conn(self, scheme: str, netloc: str) -> None:
         pool = getattr(self._local, "conns", None)
@@ -132,7 +137,8 @@ class InternalClient:
 
     def _request(self, method: str, url: str, body: Optional[bytes] = None,
                  content_type: str = "application/json",
-                 accept: Optional[str] = None) -> bytes:
+                 accept: Optional[str] = None,
+                 extra_headers: Optional[Dict[str, str]] = None) -> bytes:
         parts = urllib.parse.urlsplit(url)
         path = parts.path + (f"?{parts.query}" if parts.query else "")
         headers = {}
@@ -142,9 +148,20 @@ class InternalClient:
             headers["Accept"] = accept
         if self.key:
             headers["X-Pilosa-Key"] = self.key
+        if extra_headers:
+            headers.update(extra_headers)
         # Retry policy (one silent retry, always on a FRESH connection):
-        #   - send-phase errors: the request never reached the peer, so a
-        #     replay cannot double-apply — retry any method;
+        #   - send-phase errors on a FRESHLY-OPENED connection: the peer
+        #     provably never processed the request — retry any method;
+        #   - send-phase errors on a POOLED connection: the keep-alive
+        #     close race can deliver a partial body that proto3 may parse
+        #     as a valid truncated message, so a non-GET replay could
+        #     double-apply (e.g. a cluster message) — retry GET only.
+        #     Deliberate tradeoff: the unretried POST surfaces as status 0
+        #     and may transiently mark a healthy peer unavailable, but the
+        #     member monitor re-marks it available on its next successful
+        #     probe (~seconds), while a double-applied write diverges
+        #     replicas until anti-entropy (~minutes);
         #   - response-phase zero-byte disconnects (RemoteDisconnected):
         #     the keep-alive race; retry only idempotent methods (GET) —
         #     a POST may have been processed before the connection died,
@@ -153,15 +170,20 @@ class InternalClient:
         # retry, member monitor), so surfacing the POST error is correct.
         for attempt in (0, 1):
             sent = False
+            # Starts True so an exception INSIDE _conn (connect refused,
+            # DNS) keeps any-method retry: a failed connection attempt
+            # provably never reached the peer. Overwritten with the real
+            # freshness once _conn returns (False = pooled keep-alive).
+            fresh = True
             try:
-                conn = self._conn(parts.scheme, parts.netloc)
+                conn, fresh = self._conn(parts.scheme, parts.netloc)
                 conn.request(method, path, body=body, headers=headers)
                 sent = True
                 resp = conn.getresponse()
                 data = resp.read()
             except (http.client.HTTPException, ConnectionError, OSError) as e:
                 self._drop_conn(parts.scheme, parts.netloc)
-                retryable = (not sent) or (
+                retryable = (not sent and (fresh or method == "GET")) or (
                     method == "GET"
                     and isinstance(e, (http.client.RemoteDisconnected,
                                        http.client.BadStatusLine,
@@ -188,8 +210,12 @@ class InternalClient:
     # ---------------------------------------------------------------- query
 
     def query_node(self, node, index: str, query: str,
-                   shards: Optional[Sequence[int]] = None, remote: bool = True) -> List[Any]:
-        """Execute PQL on a peer restricted to its shards (http/client.go QueryNode)."""
+                   shards: Optional[Sequence[int]] = None, remote: bool = True,
+                   deadline: Optional[float] = None) -> List[Any]:
+        """Execute PQL on a peer restricted to its shards (http/client.go
+        QueryNode). `deadline` is the coordinator's REMAINING budget in
+        seconds; it rides X-Pilosa-Deadline so the peer aborts its own
+        device dispatches at the same cutoff."""
         from . import wire
 
         params = {"remote": "true"} if remote else {}
@@ -197,7 +223,11 @@ class InternalClient:
         if params:
             url += "?" + urllib.parse.urlencode(params)
         body = json.dumps({"query": query, "shards": list(shards) if shards else None}).encode()
-        raw = self._request("POST", url, body, accept=wire.CONTENT_TYPE)
+        extra = None
+        if deadline is not None:
+            extra = {"X-Pilosa-Deadline": f"{max(deadline, 0.0):.6f}"}
+        raw = self._request("POST", url, body, accept=wire.CONTENT_TYPE,
+                            extra_headers=extra)
         # Binary data plane when the peer speaks it (packed bitplanes);
         # JSON fallback keeps mixed-version clusters working.
         if wire.is_wire(raw):
@@ -274,6 +304,11 @@ class InternalClient:
         }).encode()
         self._request("POST", f"{_node_url(node)}/index/{index}/field/{field}/import", body)
 
+    # Marks a request as already admitted by the sending node's scheduler:
+    # the receiver skips re-admission (the body cannot carry remote:true —
+    # the translation primary must still run its own owner fan-out).
+    FORWARDED_HEADER = {"X-Pilosa-Forwarded": "1"}
+
     def import_keys_node(self, node, index: str, field: str,
                          row_ids, column_ids, row_keys, column_keys, timestamps) -> None:
         """Forward a key-mode import to the translation primary."""
@@ -284,7 +319,8 @@ class InternalClient:
             "columnKeys": list(column_keys) if column_keys else None,
             "timestamps": list(timestamps) if timestamps else None,
         }).encode()
-        self._request("POST", f"{_node_url(node)}/index/{index}/field/{field}/import", body)
+        self._request("POST", f"{_node_url(node)}/index/{index}/field/{field}/import",
+                      body, extra_headers=self.FORWARDED_HEADER)
 
     def import_value_keys_node(self, node, index: str, field: str,
                                column_keys, values) -> None:
@@ -293,7 +329,8 @@ class InternalClient:
             "columnKeys": list(column_keys),
             "values": [int(v) for v in values],
         }).encode()
-        self._request("POST", f"{_node_url(node)}/index/{index}/field/{field}/import", body)
+        self._request("POST", f"{_node_url(node)}/index/{index}/field/{field}/import",
+                      body, extra_headers=self.FORWARDED_HEADER)
 
     def import_value_node(self, node, index: str, field: str, shard: int,
                           column_ids, values) -> None:

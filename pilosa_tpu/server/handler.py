@@ -132,7 +132,8 @@ class Handler:
 
     def dispatch(self, method: str, path: str, query: Dict[str, List[str]], body: bytes,
                  headers: Optional[Dict[str, str]] = None):
-        """Returns (status, content_type, payload_bytes)."""
+        """Returns (status, content_type, payload_bytes) or the same plus
+        an extra-response-headers dict (429 carries Retry-After)."""
         headers = {k.lower(): v for k, v in (headers or {}).items()}
         if self.internal_key and path.startswith("/internal/"):
             import hmac
@@ -165,7 +166,24 @@ class Handler:
                 return 200, "application/json", json.dumps(result).encode()
             except PilosaError as e:
                 from ..errors import FragmentNotFoundError
+                from ..sched import DeadlineExceededError, QueueFullError
 
+                if isinstance(e, QueueFullError):
+                    # Load shed: tell the client WHEN to come back instead
+                    # of letting it hammer a saturated queue (Retry-After
+                    # is integer seconds per RFC 9110).
+                    import math
+
+                    retry = str(max(1, math.ceil(e.retry_after)))
+                    return (429, "application/json",
+                            json.dumps({"error": str(e)}).encode(),
+                            {"Retry-After": retry})
+                if isinstance(e, DeadlineExceededError):
+                    # The budget ran out server-side; 503 (not 400) so
+                    # clients/balancers treat it as overload, not a bad
+                    # request.
+                    return (503, "application/json",
+                            json.dumps({"error": str(e)}).encode())
                 # Missing fragments map to 404 so the anti-entropy client can
                 # treat the replica as empty instead of failing the sync
                 # (reference http/handler.go:776,984,1030).
@@ -252,18 +270,55 @@ class Handler:
         else:
             req = _json_body(body)
         shard = req.get("shard", 0)
-        if "values" in req:
-            self.api.import_values(
-                index, field, shard, req.get("columnIDs"), req["values"],
-                remote=req.get("remote", False),
-                column_keys=req.get("columnKeys"),
-            )
+
+        def run():
+            if "values" in req:
+                self.api.import_values(
+                    index, field, shard, req.get("columnIDs"), req["values"],
+                    remote=req.get("remote", False),
+                    column_keys=req.get("columnKeys"),
+                )
+            else:
+                self.api.import_bits(
+                    index, field, shard, req.get("rowIDs", []), req.get("columnIDs", []),
+                    req.get("timestamps"), remote=req.get("remote", False),
+                    row_keys=req.get("rowKeys"), column_keys=req.get("columnKeys"),
+                )
+
+        # Imports ride the scheduler's batch class — bounded concurrency
+        # keeps bulk loads from starving interactive queries of executor
+        # slots, and a full queue sheds with 429 backpressure. Admission
+        # happens HERE (not inside import_bits) because key-mode imports
+        # recurse per shard; admitting inside the recursion would nest
+        # slot acquisitions and self-deadlock at low concurrency limits.
+        # Replication forwards (remote=True) and key-mode imports
+        # forwarded to the translation primary (X-Pilosa-Forwarded; the
+        # body can't say remote:true because the primary must run its own
+        # owner fan-out) skip admission for the same reason remote
+        # queries do: the originating node already admitted the work, and
+        # nodes holding batch slots while blocked in each other's
+        # admission queues would deadlock the write path.
+        scheduler = getattr(self.api.server, "scheduler", None)
+        forwarded = (headers or {}).get("x-pilosa-forwarded") == "1"
+        if forwarded and self.internal_key:
+            # On a keyed cluster, only an authenticated peer may claim
+            # "already admitted" — otherwise any public client could strap
+            # the header onto bulk imports and bypass batch-class shedding.
+            # (Open clusters trust it, matching the trust model of the
+            # equally-spoofable remote flag in the body.)
+            import hmac
+
+            presented = (headers or {}).get(
+                "x-pilosa-key", "").encode("latin-1", "replace")
+            forwarded = hmac.compare_digest(
+                presented, self.internal_key.encode())
+        if scheduler is None or req.get("remote") or forwarded:
+            run()
         else:
-            self.api.import_bits(
-                index, field, shard, req.get("rowIDs", []), req.get("columnIDs", []),
-                req.get("timestamps"), remote=req.get("remote", False),
-                row_keys=req.get("rowKeys"), column_keys=req.get("columnKeys"),
-            )
+            from ..sched import CLASS_BATCH
+
+            with scheduler.admit(CLASS_BATCH):
+                run()
         return {}
 
     def handle_post_query(self, index, body, query, headers=None, **kw):
@@ -271,6 +326,13 @@ class Handler:
         wants_proto = "application/x-protobuf" in headers.get("accept", "")
         is_proto = "application/x-protobuf" in headers.get("content-type", "")
         shards = None
+        # Per-request budget: X-Pilosa-Deadline carries REMAINING seconds
+        # (coordinators forward their leftover budget to peers); absent,
+        # the scheduler's configured default applies.
+        scheduler = getattr(self.api.server, "scheduler", None)
+        deadline = None
+        if scheduler is not None:
+            deadline = scheduler.deadline_for(headers.get("x-pilosa-deadline"))
         remote = query.get("remote", ["false"])[0] == "true"
         column_attrs = query.get("columnAttrs", ["false"])[0] == "true"
         exclude_row_attrs = query.get("excludeRowAttrs", ["false"])[0] == "true"
@@ -306,8 +368,13 @@ class Handler:
                     index, pql, shards=shards, remote=remote,
                     exclude_row_attrs=exclude_row_attrs,
                     exclude_columns=exclude_columns,
+                    deadline=deadline,
                 )
             except PilosaError as e:
+                from ..sched import DeadlineExceededError, QueueFullError
+
+                if isinstance(e, (QueueFullError, DeadlineExceededError)):
+                    raise  # keep 429/503 semantics over a proto 400
                 return 400, "application/x-protobuf", proto.encode_query_response([], err=str(e))
             cas = None
             if column_attrs:
@@ -316,7 +383,8 @@ class Handler:
             return 200, "application/x-protobuf", payload
 
         if remote:
-            results = self.api.query(index, pql, shards=shards, remote=True)
+            results = self.api.query(index, pql, shards=shards, remote=True,
+                                     deadline=deadline)
             from . import wire
 
             if wire.CONTENT_TYPE in headers.get("accept", ""):
@@ -327,6 +395,7 @@ class Handler:
         return self.api.query_response(
             index, pql, shards=shards, column_attrs=column_attrs,
             exclude_row_attrs=exclude_row_attrs, exclude_columns=exclude_columns,
+            deadline=deadline,
         )
 
     def _column_attr_sets(self, index, results):
@@ -477,6 +546,17 @@ class Handler:
         if engine is not None:
             out = dict(out)
             out["engine_cache"] = dict(engine.counters)
+        # Scheduler lifecycle metrics: queue depth, admit/shed/deadline
+        # counts, and the micro-batcher's launch/coalesce counters (wait
+        # time and batch-size histograms live in the stats timings above).
+        scheduler = getattr(self.api.server, "scheduler", None)
+        if scheduler is not None:
+            out = dict(out)
+            out["scheduler"] = scheduler.snapshot()
+        batcher = getattr(self.api.server, "batcher", None)
+        if batcher is not None:
+            out = dict(out)
+            out["batcher"] = batcher.snapshot()
         return out
 
     _profile_lock = threading.Lock()
@@ -563,13 +643,20 @@ class _RequestHandler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
-        status, ctype, payload = self.handler.dispatch(
+        result = self.handler.dispatch(
             method, parsed.path.rstrip("/") or "/", parse_qs(parsed.query), body,
             headers=dict(self.headers),
         )
+        extra_headers = {}
+        if len(result) == 4:
+            status, ctype, payload, extra_headers = result
+        else:
+            status, ctype, payload = result
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
+        for k, v in extra_headers.items():
+            self.send_header(k, v)
         if self.handler.allowed_origins:
             # The ACAO value varies with the request Origin; shared caches
             # must not serve one origin's response to another.

@@ -69,12 +69,28 @@ def _encode_node(node_pb, d: dict) -> None:
     if "://" in uri:
         scheme, uri = uri.split("://", 1)
     host, port = uri, 0
-    if ":" in uri:
+    if uri.startswith("["):
+        # Bracketed IPv6, '[::1]:10101' or '[::1]': brackets are wire
+        # syntax, not part of the address — URI.Host carries the bare
+        # address (reference uri.go parses the same way).
+        end = uri.find("]")
+        if end != -1:
+            host = uri[1:end]
+            rest = uri[end + 1:]
+            if rest.startswith(":"):
+                try:
+                    port = int(rest[1:])
+                except ValueError:
+                    port = 0
+    elif uri.count(":") == 1:
         host, port_s = uri.rsplit(":", 1)
         try:
             port = int(port_s)
         except ValueError:
             host, port = uri, 0
+    # else: zero colons (plain host, no port) or 2+ colons (a bare
+    # unbracketed IPv6 address like '::1') — the whole string is the host;
+    # blind rsplit would have mangled '::1' into host ':' port 1.
     node_pb.URI.Scheme = scheme
     node_pb.URI.Host = host
     node_pb.URI.Port = port
@@ -86,7 +102,11 @@ def _encode_node(node_pb, d: dict) -> None:
 def _decode_node(node_pb) -> dict:
     uri = node_pb.URI.Host
     if node_pb.URI.Port:
-        uri = f"{uri}:{node_pb.URI.Port}"
+        # Re-bracket IPv6 hosts so 'host:port' parses unambiguously.
+        if ":" in uri:
+            uri = f"[{uri}]:{node_pb.URI.Port}"
+        else:
+            uri = f"{uri}:{node_pb.URI.Port}"
     if node_pb.URI.Scheme and node_pb.URI.Scheme != "http":
         uri = f"{node_pb.URI.Scheme}://{uri}"
     d = {"id": node_pb.ID, "uri": uri,

@@ -57,6 +57,7 @@ class Server:
         member_probe_timeout: float = 2.0,
         coordinator_failover_probes: int = 3,
         internal_key_path: Optional[str] = None,
+        scheduler_config=None,
         join_addr: Optional[str] = None,
         allowed_origins: Optional[List[str]] = None,
         tls_certificate: Optional[str] = None,
@@ -140,6 +141,27 @@ class Server:
             max_writes_per_request=max_writes_per_request,
             workers=executor_workers,
         )
+        # Query scheduler (sched/): admission control + deadlines +
+        # cross-query micro-batching, the gate between the HTTP handler
+        # and the executor. The batcher pulls the engine LAZILY so
+        # constructing a server never opens the device backend.
+        from ..sched import (
+            CLASS_INTERACTIVE, MicroBatcher, QueryScheduler, SchedulerConfig,
+        )
+
+        sched_cfg = scheduler_config or SchedulerConfig()
+        self.scheduler = QueryScheduler(sched_cfg, stats=self.stats)
+        self.batcher = MicroBatcher(
+            lambda: self.executor.engine,
+            window=sched_cfg.batch_window,
+            window_max=sched_cfg.batch_window_max,
+            batch_max=sched_cfg.batch_max,
+            # Interactive pressure only: batch-class imports are never
+            # coalescing candidates, so they must not hold the window open.
+            depth_fn=lambda: self.scheduler.pressure(CLASS_INTERACTIVE),
+            stats=self.stats,
+        )
+        self.executor.batcher = self.batcher
         self.api = API(self)
         self.handler = Handler(
             self.api, logger=self.logger, allowed_origins=allowed_origins,
@@ -610,11 +632,16 @@ class Server:
                 # this merge a non-coordinator node never knows which peer
                 # to forward joins to — and cannot detect the coordinator's
                 # death for failover. Conflicting claims are settled by
-                # _reconcile_dual_coordinator (lowest id wins).
-                node.is_coordinator = any(
-                    n.get("id") == node.id and n.get("isCoordinator")
-                    for n in status.get("nodes", [])
-                )
+                # _reconcile_dual_coordinator (lowest id wins). Merge ONLY
+                # when the payload actually carries a nodes list: a partial
+                # response (older build, truncated body) must not silently
+                # clear the peer's flag and erase the only known
+                # coordinator.
+                if "nodes" in status:
+                    node.is_coordinator = any(
+                        n.get("id") == node.id and n.get("isCoordinator")
+                        for n in status.get("nodes", [])
+                    )
                 if node.is_coordinator:
                     # An ALIVE self-claimer supersedes a dead flagged
                     # holdover (a survivor that missed the failover

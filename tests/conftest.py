@@ -26,8 +26,51 @@ except AttributeError:
 
 
 import subprocess
+import threading
 
 import pytest
+
+
+def pytest_configure(config):
+    # Registered here (no pytest.ini in this repo) so tier-1's
+    # `-m 'not slow'` selection works without unknown-mark warnings.
+    config.addinivalue_line(
+        "markers",
+        "slow: timing-sensitive tests (real micro-batch windows, device "
+        "benchmarks) excluded from the tier-1 CPU run",
+    )
+
+
+class FakeClock:
+    """Deterministic monotonic clock for scheduler tests.
+
+    Injectable wherever sched/ takes `clock` (Deadline, QueryScheduler):
+    time() only moves when a test calls advance() or when a sleeper
+    'sleeps' (sleep advances the clock immediately instead of blocking),
+    so deadline tests run deterministically on CPU with zero wall-clock
+    waits. Batcher window tests drive its `wait_window` hook instead."""
+
+    def __init__(self, start: float = 1000.0):
+        self._now = start
+        self._lock = threading.Lock()
+
+    def time(self) -> float:
+        with self._lock:
+            return self._now
+
+    __call__ = time  # usable directly as the `clock` callable
+
+    def advance(self, seconds: float) -> None:
+        with self._lock:
+            self._now += seconds
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)
+
+
+@pytest.fixture
+def fake_clock():
+    return FakeClock()
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,623 @@
+"""Query scheduler tests: admission control, deadlines, micro-batching.
+
+Deterministic on the 8-device CPU mesh: the window tests drive the
+batcher's injectable sleep hook (the leader's window ends exactly when
+every expected query has enqueued), and deadline tests use the fake
+monotonic clock from conftest. The real-window timing test is marked
+`slow` and excluded from tier-1.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.errors import PilosaError
+from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.sched import (
+    CLASS_BATCH,
+    CLASS_INTERACTIVE,
+    Deadline,
+    DeadlineExceededError,
+    MicroBatcher,
+    QueryScheduler,
+    QueueFullError,
+    SchedulerConfig,
+)
+from pilosa_tpu.pql.parser import parse
+
+
+# ------------------------------------------------------------- fixtures
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+def plant(holder, n_shards=4, n_rows=8):
+    """Rows 1..n_rows of field f spread over n_shards shards."""
+    idx = holder.create_index_if_not_exists("i")
+    idx.create_field_if_not_exists("f")
+    fld = idx.field("f")
+    rng = np.random.default_rng(7)
+    expected = {}
+    for row in range(1, n_rows + 1):
+        cols = []
+        for s in range(n_shards):
+            local = np.flatnonzero(rng.random(2048) < 0.3)
+            cols.extend(int(s * SHARD_WIDTH + c) for c in local)
+        fld.import_bits([row] * len(cols), cols)
+        expected[row] = len(set(cols))
+    return expected
+
+
+# ------------------------------------------------------------- deadline
+
+
+def test_deadline_basics(fake_clock):
+    d = Deadline(2.0, clock=fake_clock)
+    assert not d.expired()
+    assert d.remaining() == pytest.approx(2.0)
+    d.check("anywhere")  # no raise
+    fake_clock.advance(2.5)
+    assert d.expired()
+    with pytest.raises(DeadlineExceededError):
+        d.check("device dispatch")
+
+
+def test_deadline_from_header(fake_clock):
+    d = Deadline.from_header("1.5", clock=fake_clock)
+    assert d.remaining() == pytest.approx(1.5)
+    # Malformed header falls back to the default instead of erroring.
+    d = Deadline.from_header("bogus", default_s=3.0, clock=fake_clock)
+    assert d.remaining() == pytest.approx(3.0)
+    assert Deadline.from_header(None) is None
+    assert Deadline.from_header("", default_s=0.0) is None
+    # Non-finite values are malformed, not budgets: 'nan' must never
+    # reach semaphore timeouts (it busy-spins Condition.wait), and 'inf'
+    # is "no deadline" said confusingly.
+    for bad in ("nan", "inf", "-inf"):
+        assert Deadline.from_header(bad) is None
+        d = Deadline.from_header(bad, default_s=2.0, clock=fake_clock)
+        assert d.remaining() == pytest.approx(2.0)
+    # Zero/negative = already-spent budget (coordinators forward
+    # max(remaining, 0), so 0 must read as expired).
+    assert Deadline.from_header("0", clock=fake_clock).expired()
+    assert Deadline.from_header("-1", clock=fake_clock).expired()
+
+
+# ------------------------------------------------------------ admission
+
+
+def test_admission_reject_when_queue_full():
+    sched = QueryScheduler(SchedulerConfig(
+        max_queue=1, interactive_concurrency=1, retry_after=7.0))
+    hold = threading.Event()
+    entered = threading.Event()
+    errors = []
+
+    def occupant():
+        with sched.admit(CLASS_INTERACTIVE):
+            entered.set()
+            hold.wait(timeout=10)
+
+    def waiter():
+        try:
+            with sched.admit(CLASS_INTERACTIVE):
+                pass
+        except PilosaError as e:  # pragma: no cover - not expected
+            errors.append(e)
+
+    t1 = threading.Thread(target=occupant)
+    t1.start()
+    assert entered.wait(timeout=5)
+    t2 = threading.Thread(target=waiter)
+    t2.start()
+    # Wait for the waiter to actually occupy the one queue slot.
+    deadline = time.monotonic() + 5
+    while sched.queue_depth() < 1 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert sched.queue_depth() == 1
+    with pytest.raises(QueueFullError) as ei:
+        with sched.admit(CLASS_INTERACTIVE):
+            pass  # pragma: no cover - shed before entry
+    assert ei.value.retry_after == 7.0
+    assert sched.counters["shed"] == 1
+    hold.set()
+    t1.join(timeout=5)
+    t2.join(timeout=5)
+    assert not errors
+    assert sched.counters["admitted"] == 2
+    assert sched.queue_depth() == 0
+
+
+def test_admission_no_queue_still_admits_free_slot():
+    """max_queue=0 means never WAIT — an idle class still admits."""
+    sched = QueryScheduler(SchedulerConfig(max_queue=0,
+                                           interactive_concurrency=1))
+    with sched.admit(CLASS_INTERACTIVE):
+        # Slot taken and the queue is disabled: next request sheds.
+        with pytest.raises(QueueFullError):
+            with sched.admit(CLASS_INTERACTIVE):
+                pass  # pragma: no cover
+    assert sched.counters["admitted"] == 1
+    assert sched.counters["shed"] == 1
+
+
+def test_admission_expired_deadline_rejected(fake_clock):
+    sched = QueryScheduler(SchedulerConfig(), clock=fake_clock)
+    d = Deadline(0.5, clock=fake_clock)
+    fake_clock.advance(1.0)
+    with pytest.raises(DeadlineExceededError):
+        with sched.admit(CLASS_INTERACTIVE, d):
+            pass  # pragma: no cover
+    assert sched.counters["deadline_exceeded"] == 1
+    assert sched.counters["admitted"] == 0
+
+
+def test_admission_deadline_bounds_queued_wait():
+    """A query whose whole budget elapses in the queue is rejected
+    without ever running (real clock: a blocked thread can only be
+    preempted by a real timeout)."""
+    sched = QueryScheduler(SchedulerConfig(interactive_concurrency=1))
+    hold = threading.Event()
+    entered = threading.Event()
+
+    def occupant():
+        with sched.admit(CLASS_INTERACTIVE):
+            entered.set()
+            hold.wait(timeout=10)
+
+    t = threading.Thread(target=occupant)
+    t.start()
+    assert entered.wait(timeout=5)
+    with pytest.raises(DeadlineExceededError):
+        with sched.admit(CLASS_INTERACTIVE, Deadline(0.05)):
+            pass  # pragma: no cover
+    assert sched.counters["deadline_exceeded"] == 1
+    hold.set()
+    t.join(timeout=5)
+
+
+def test_class_limits_are_independent():
+    """Import traffic saturating its class must not block interactive
+    admission (and vice versa): the classes own separate slots."""
+    sched = QueryScheduler(SchedulerConfig(
+        interactive_concurrency=2, batch_concurrency=1, max_queue=4))
+    hold = threading.Event()
+    entered = threading.Event()
+
+    def batch_occupant():
+        with sched.admit(CLASS_BATCH):
+            entered.set()
+            hold.wait(timeout=10)
+
+    t = threading.Thread(target=batch_occupant)
+    t.start()
+    assert entered.wait(timeout=5)
+    # Batch class is saturated...
+    snap = sched.snapshot()
+    assert snap["running"][CLASS_BATCH] == 1
+    # ...but interactive admits immediately, twice.
+    with sched.admit(CLASS_INTERACTIVE):
+        with sched.admit(CLASS_INTERACTIVE):
+            snap = sched.snapshot()
+            assert snap["running"][CLASS_INTERACTIVE] == 2
+    hold.set()
+    t.join(timeout=5)
+    assert sched.counters["admitted_interactive"] == 2
+    assert sched.counters["admitted_batch"] == 1
+
+
+def test_pressure_is_per_class():
+    """Queued + running imports must not register as interactive pressure
+    (they can never coalesce with a count query, so they must not hold
+    the micro-batch window open)."""
+    sched = QueryScheduler(SchedulerConfig(
+        interactive_concurrency=4, batch_concurrency=1, max_queue=8))
+    hold = threading.Event()
+    entered = threading.Event()
+
+    def occupant():
+        with sched.admit(CLASS_BATCH):
+            entered.set()
+            hold.wait(timeout=10)
+
+    def waiter():
+        with sched.admit(CLASS_BATCH):
+            pass
+
+    t1 = threading.Thread(target=occupant)
+    t1.start()
+    assert entered.wait(timeout=5)
+    t2 = threading.Thread(target=waiter)
+    t2.start()
+    deadline = time.monotonic() + 5
+    while sched.queue_depth() < 1 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    # One import running + one queued: zero interactive pressure.
+    assert sched.pressure(CLASS_BATCH) == 2
+    assert sched.pressure(CLASS_INTERACTIVE) == 0
+    hold.set()
+    t1.join(timeout=5)
+    t2.join(timeout=5)
+
+
+def test_peer_deadline_503_is_not_node_failure():
+    """A peer answering 503 'deadline exceeded' ran out of REQUEST budget;
+    the coordinator must not mark the healthy node unavailable."""
+    from pilosa_tpu.executor import _is_node_failure
+    from pilosa_tpu.server.client import ClientError
+
+    assert not _is_node_failure(
+        ClientError("POST http://n2/index/i/query: 503 "
+                    '{"error": "query deadline exceeded at device dispatch"}',
+                    status=503))
+    assert _is_node_failure(ClientError("boom", status=503))
+    assert _is_node_failure(ClientError("conn refused", status=0))
+    assert not _is_node_failure(ClientError("bad query", status=400))
+
+
+# ------------------------------------------------- executor integration
+
+
+def test_expired_deadline_aborts_before_device_dispatch(holder, fake_clock):
+    """Acceptance: an expired deadline aborts BEFORE the next device
+    dispatch — the engine's launch counters stay untouched."""
+    plant(holder)
+    ex = Executor(holder, workers=0)
+    d = Deadline(0.5, clock=fake_clock)
+    fake_clock.advance(1.0)
+    before = ex.engine.counters["count_dispatches"]
+    with pytest.raises(DeadlineExceededError):
+        ex.execute("i", "Count(Row(f=1))", opt=ExecOptions(deadline=d))
+    assert ex.engine.counters["count_dispatches"] == before
+
+
+def test_deadline_expires_mid_map_reduce(holder, fake_clock):
+    """Per-shard gate: the budget runs out between shard maps and the
+    remaining shards never run."""
+    plant(holder, n_shards=3)
+    ex = Executor(holder, workers=0)  # serial map, deterministic order
+    d = Deadline(1.0, clock=fake_clock)
+    calls = []
+
+    def map_fn(shard):
+        calls.append(shard)
+        fake_clock.advance(0.6)  # each shard costs 0.6s of fake time
+        return 1
+
+    c = parse("Count(Row(f=1))").calls[0]
+    with pytest.raises(DeadlineExceededError):
+        ex._map_reduce("i", [0, 1, 2], c, ExecOptions(deadline=d),
+                       map_fn, lambda a, b: a + b)
+    # Shard 0 ran (t=0 ok), shard 1 ran (t=0.6 ok), shard 2 aborted (t=1.2).
+    assert calls == [0, 1]
+
+
+# -------------------------------------------------------- micro-batcher
+
+
+def _coalescing_setup(holder, monkeypatch, n_queries):
+    """Executor wired to a batcher whose window deterministically closes
+    once all n_queries have enqueued: batch_max == n_queries, so the
+    n-th arrival fills the group and wakes the leader (the production
+    full-event path), with a generous window as the only fallback."""
+    # Disable the result memo so a repeat query can't skip the device:
+    # without the batcher each of the N queries would be its own launch,
+    # making dispatches-vs-queries a true coalescing measurement.
+    monkeypatch.setenv("PILOSA_MEMO_ENTRIES", "0")
+    ex = Executor(holder, workers=0)
+    engine = ex.engine  # force creation under the env override
+    batcher = MicroBatcher(
+        lambda: engine,
+        window=2.0, window_max=10.0, batch_max=n_queries,
+        depth_fn=lambda: n_queries,
+    )
+    ex.batcher = batcher
+    return ex, engine, batcher
+
+
+def test_microbatch_coalesces_identical_counts(holder, monkeypatch):
+    """Acceptance: >= 8 simultaneous identical Count queries over one
+    resident stack run with FEWER engine dispatches than queries (engine
+    counters) and return byte-identical results to the unbatched path."""
+    expected = plant(holder)
+    n = 8
+    # Unbatched ground truth from a separate executor (its own engine).
+    ex0 = Executor(holder, workers=0)
+    truth = ex0.execute("i", "Count(Row(f=1))")[0]
+    assert truth == expected[1]
+
+    ex, engine, batcher = _coalescing_setup(holder, monkeypatch, n)
+    results = [None] * n
+    barrier = threading.Barrier(n)
+
+    def client(i):
+        barrier.wait(timeout=10)
+        results[i] = ex.execute("i", "Count(Row(f=1))")[0]
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    before = engine.counters["count_dispatches"]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    dispatches = engine.counters["count_dispatches"] - before
+    assert results == [truth] * n
+    assert dispatches < n, f"no coalescing: {dispatches} dispatches for {n} queries"
+    assert batcher.counters["launches"] >= 1
+    assert batcher.counters["enqueued"] == n
+    assert batcher.counters["coalesced"] == n - batcher.counters["launches"]
+
+
+def test_microbatch_coalesces_distinct_rows_byte_identical(holder, monkeypatch):
+    """Structurally identical but DISTINCT queries coalesce into one
+    launch and split back per caller with exact per-query results."""
+    expected = plant(holder, n_rows=8)
+    n = 8
+    ex0 = Executor(holder, workers=0)
+    truth = {row: ex0.execute("i", f"Count(Row(f={row}))")[0]
+             for row in range(1, n + 1)}
+    assert truth == expected
+
+    ex, engine, batcher = _coalescing_setup(holder, monkeypatch, n)
+    results = {}
+    lock = threading.Lock()
+    barrier = threading.Barrier(n)
+
+    def client(row):
+        barrier.wait(timeout=10)
+        r = ex.execute("i", f"Count(Row(f={row}))")[0]
+        with lock:
+            results[row] = r
+
+    threads = [threading.Thread(target=client, args=(row,))
+               for row in range(1, n + 1)]
+    before = engine.counters["count_dispatches"]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results == truth
+    assert engine.counters["count_dispatches"] - before < n
+
+
+def test_microbatch_group_key_respects_write_epoch(holder):
+    """The group key carries the index write epoch: a write between
+    batches starts a new group rather than reusing the old key."""
+    plant(holder)
+    ex = Executor(holder, workers=0)
+    engine = ex.engine
+    g1 = engine.stack_generation("i")
+    holder.field("i", "f").set_bit(1, 5)
+    g2 = engine.stack_generation("i")
+    assert g2 > g1
+    assert engine.stack_generation("missing") == -1
+
+
+def test_microbatch_single_query_no_window(holder):
+    """A lone query (pressure <= 1) dispatches immediately — the window
+    must not add latency when there is nobody to coalesce with."""
+    plant(holder)
+    ex = Executor(holder, workers=0)
+    waited = []
+    batcher = MicroBatcher(
+        lambda: ex.engine, depth_fn=lambda: 1,
+        wait_window=lambda group, w: waited.append(w),
+    )
+    ex.batcher = batcher
+    assert ex.execute("i", "Count(Row(f=1))")[0] > 0
+    assert waited == []  # straight through, no window
+    assert batcher.counters["enqueued"] == 0
+
+
+# ------------------------------------------------------------- HTTP layer
+
+
+@pytest.fixture
+def server(tmp_path):
+    from pilosa_tpu.server.server import Server
+
+    s = Server(
+        data_dir=str(tmp_path / "node0"), cache_flush_interval=0,
+        scheduler_config=SchedulerConfig(
+            max_queue=0, interactive_concurrency=1, retry_after=3.0),
+    )
+    s.open()
+    yield s
+    s.close()
+
+
+def _post_query(port, body, headers=None):
+    import http.client
+
+    conn = http.client.HTTPConnection(f"localhost:{port}", timeout=30)
+    try:
+        conn.request("POST", "/index/i/query", body=body.encode(),
+                     headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def test_http_429_with_retry_after_when_full(server):
+    """Acceptance: a full queue returns 429 + Retry-After, observable in
+    scheduler stats."""
+    from pilosa_tpu.server.client import InternalClient
+
+    client = InternalClient()
+    host = f"localhost:{server.port}"
+    client.create_index(host, "i")
+    client.create_field(host, "i", "f")
+    client.query(host, "i", "Set(1, f=1)")
+
+    hold = threading.Event()
+    entered = threading.Event()
+    real_execute = server.executor.execute
+
+    def slow_execute(*a, **kw):
+        entered.set()
+        hold.wait(timeout=10)
+        return real_execute(*a, **kw)
+
+    server.executor.execute = slow_execute
+    try:
+        t = threading.Thread(
+            target=_post_query, args=(server.port, "Count(Row(f=1))"))
+        t.start()
+        assert entered.wait(timeout=10)
+        # Slot busy, queue disabled -> immediate shed.
+        status, headers, body = _post_query(server.port, "Count(Row(f=1))")
+        assert status == 429
+        assert headers.get("Retry-After") == "3"
+        assert "queue full" in json.loads(body)["error"]
+    finally:
+        hold.set()
+        t.join(timeout=10)
+        server.executor.execute = real_execute
+    snap = server.scheduler.snapshot()
+    assert snap["shed"] >= 1
+    assert snap["admitted"] >= 1
+
+
+def test_http_deadline_header_and_stats(server):
+    from pilosa_tpu.server.client import InternalClient
+
+    client = InternalClient()
+    host = f"localhost:{server.port}"
+    client.create_index(host, "i")
+    client.create_field(host, "i", "f")
+    client.query(host, "i", "Set(1, f=1)")
+    # Generous budget: normal 200.
+    status, _, body = _post_query(server.port, "Count(Row(f=1))",
+                                  {"X-Pilosa-Deadline": "30"})
+    assert status == 200
+    assert json.loads(body)["results"][0] == 1
+    # Already-spent budget: 503 before any device dispatch.
+    before = server.scheduler.snapshot()["deadline_exceeded"]
+    status, _, body = _post_query(server.port, "Count(Row(f=1))",
+                                  {"X-Pilosa-Deadline": "0"})
+    assert status == 503
+    assert "deadline" in json.loads(body)["error"]
+    assert server.scheduler.snapshot()["deadline_exceeded"] == before + 1
+
+
+def test_debug_vars_scheduler_metrics(server):
+    from pilosa_tpu.server.client import InternalClient
+
+    client = InternalClient()
+    host = f"localhost:{server.port}"
+    client.create_index(host, "i")
+    client.create_field(host, "i", "f")
+    client.query(host, "i", "Set(1, f=1)")
+    client.query(host, "i", "Count(Row(f=1))")
+    with urllib.request.urlopen(f"http://{host}/debug/vars") as resp:
+        dv = json.load(resp)
+    assert dv["scheduler"]["admitted"] >= 1
+    assert "queue_depth" in dv["scheduler"]
+    assert "launches" in dv["batcher"]
+
+
+def test_remote_subqueries_bypass_admission(server):
+    """Forwarded (remote=True) sub-queries were already admitted at the
+    coordinator; re-admitting them would form cross-node slot-wait cycles
+    under saturation, so they must not consume admission slots."""
+    from pilosa_tpu.server.client import InternalClient
+
+    client = InternalClient()
+    host = f"localhost:{server.port}"
+    client.create_index(host, "i")
+    client.create_field(host, "i", "f")
+    client.query(host, "i", "Set(1, f=1)")
+    before = server.scheduler.counters["admitted"]
+    results = server.api.query("i", "Count(Row(f=1))", remote=True)
+    assert results[0] == 1
+    assert server.scheduler.counters["admitted"] == before
+    # Replication-forwarded imports (remote=True) bypass too.
+    before_batch = server.scheduler.counters["admitted_batch"]
+    status, _, _ = _post_import_remote(server.port)
+    assert status == 200
+    assert server.scheduler.counters["admitted_batch"] == before_batch
+    # ...and so do key-mode imports forwarded to the translation primary
+    # (X-Pilosa-Forwarded header; their body cannot carry remote:true).
+    status, _, _ = _post_import_remote(
+        server.port, body={"shard": 0, "rowIDs": [3], "columnIDs": [8]},
+        headers={"X-Pilosa-Forwarded": "1"})
+    assert status == 200
+    assert server.scheduler.counters["admitted_batch"] == before_batch
+    # Remote-path deadline expiries are still counted in scheduler stats.
+    before_dl = server.scheduler.counters["deadline_exceeded"]
+    expired = Deadline(0.0)
+    with pytest.raises(DeadlineExceededError):
+        server.api.query("i", "Count(Row(f=1))", remote=True, deadline=expired)
+    assert server.scheduler.counters["deadline_exceeded"] == before_dl + 1
+
+
+def _post_import_remote(port, body=None, headers=None):
+    import http.client
+
+    conn = http.client.HTTPConnection(f"localhost:{port}", timeout=30)
+    try:
+        payload = json.dumps(body or {"shard": 0, "rowIDs": [2],
+                                      "columnIDs": [7],
+                                      "remote": True}).encode()
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        conn.request("POST", "/index/i/field/f/import", body=payload,
+                     headers=hdrs)
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def test_imports_ride_batch_class(server):
+    from pilosa_tpu.server.client import InternalClient
+
+    client = InternalClient()
+    host = f"localhost:{server.port}"
+    client.create_index(host, "i")
+    client.create_field(host, "i", "f")
+    client.import_bits(host, "i", "f", [(1, 10), (1, 20)])
+    assert server.scheduler.counters["admitted_batch"] >= 1
+
+
+@pytest.mark.slow
+def test_microbatch_real_window_coalesces(holder, monkeypatch):
+    """Timing-sensitive twin of the deterministic coalescing test: real
+    ~2ms window, real sleeps. Excluded from tier-1 (`-m 'not slow'`)."""
+    plant(holder)
+    monkeypatch.setenv("PILOSA_MEMO_ENTRIES", "0")
+    ex = Executor(holder, workers=0)
+    engine = ex.engine
+    ex.batcher = MicroBatcher(
+        lambda: engine, window=0.002, window_max=0.02, batch_max=64,
+        depth_fn=lambda: 8,
+    )
+    n = 8
+    results = [None] * n
+    barrier = threading.Barrier(n)
+
+    def client(i):
+        barrier.wait(timeout=10)
+        results[i] = ex.execute("i", "Count(Row(f=1))")[0]
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    before = engine.counters["count_dispatches"]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(set(results)) == 1 and results[0] is not None
+    assert engine.counters["count_dispatches"] - before < n
