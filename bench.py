@@ -1198,6 +1198,26 @@ def bench_open():
     }
 
 
+def _write_bench_out(line):
+    """Atomically (re)write the BENCH_OUT file, fsynced, so whatever ran
+    to completion survives even a kill -9 of the bench itself. Best-effort:
+    an unwritable BENCH_OUT must never abort the bench — stdout still
+    carries every checkpoint line."""
+    out_path = os.environ.get("BENCH_OUT")
+    if not out_path:
+        return
+    try:
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, out_path)
+    except OSError as e:
+        print(f"bench: cannot write BENCH_OUT={out_path}: {e}",
+              file=sys.stderr)
+
+
 def _last_json_line(text):
     """Last parseable JSON object line in `text` (a child bench's stdout)."""
     for line in reversed((text or "").strip().splitlines()):
@@ -1236,7 +1256,12 @@ def main():
             f"BENCH_DEADLINE {deadline}s exceeded; results are partial "
             "(a device call likely blocked on a dead tunnel)"
         )
-        print(json.dumps(partial), flush=True)
+        line = json.dumps(partial)
+        print(line, flush=True)
+        try:
+            _write_bench_out(line)
+        except OSError:
+            pass
         os._exit(3)
 
     if deadline > 0:
@@ -1375,9 +1400,26 @@ def main():
     holder.close()
     del holder, ex
 
+    def emit_partial(note):
+        """Persist everything collected SO FAR: a JSON line on stdout (the
+        driver parses the LAST parseable line, so a driver-side timeout —
+        rc=124 — still records completed stanzas instead of nothing) and,
+        when BENCH_OUT names a file, an atomic rewrite of that file. The
+        `partial` marker tells downstream consumers (and our own TPU-child
+        handoff above) this line is a checkpoint, not the final verdict."""
+        snap = json.loads(json.dumps(partial))
+        snap["detail"]["partial"] = note
+        line = json.dumps(snap)
+        print(line, flush=True)
+        _write_bench_out(line)
+
+    emit_partial("headline stanza complete")
+
     def stanza(name, fn):
         """Run one optional stanza; a crash records the error instead of
-        killing the whole bench line."""
+        killing the whole bench line, and every completion checkpoints the
+        results collected so far (two consecutive rounds of rc=124 drivers
+        recorded `parsed: null` because all output waited for the end)."""
         if os.environ.get(f"BENCH_{name}") == "0":
             return {"skipped": f"BENCH_{name}=0"}
         try:
@@ -1385,6 +1427,7 @@ def main():
         except Exception as e:
             out = {"error": f"{type(e).__name__}: {e}"[:500]}
         partial["detail"][name.lower()] = out
+        emit_partial(f"through stanza {name}")
         return out
 
     hbm = stanza("HBM", bench_hbm)
@@ -1467,7 +1510,9 @@ def main():
                 }
                 child["detail"]["parent_probes"] = probes
                 state["done"] = True
-                print(json.dumps(child))
+                line = json.dumps(child)
+                print(line, flush=True)
+                _write_bench_out(line)
                 return
     stop_prober.set()
 
@@ -1477,7 +1522,7 @@ def main():
         extra["tpu_child_error"] = child_error
         if "tpu_child_partial" in partial["detail"]:
             extra["tpu_child_partial"] = partial["detail"]["tpu_child_partial"]
-    print(json.dumps({
+    final_line = json.dumps({
         "metric": "count_intersect_qps_8shards",
         "value": round(count_qps, 2),
         "unit": "queries/sec",
@@ -1504,7 +1549,9 @@ def main():
             "time_range": time_range,
             **extra,
         },
-    }))
+    })
+    print(final_line, flush=True)
+    _write_bench_out(final_line)
 
 
 if __name__ == "__main__":

@@ -62,6 +62,13 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    type=float)
     p.add_argument("--sched-batch-max", dest="sched_batch_max", type=int,
                    help="max queries coalesced into one device launch")
+    p.add_argument("--storage-fsync", dest="storage_fsync",
+                   choices=["never", "batch", "always"],
+                   help="WAL/snapshot durability: never (page cache only), "
+                        "batch (sync every N ops, the default), always "
+                        "(sync per write)")
+    p.add_argument("--storage-fsync-batch-ops", dest="storage_fsync_batch_ops",
+                   type=int, help="ops between WAL fsyncs in batch mode")
     p.add_argument("--translation-primary-url", dest="translation_primary_url")
     p.add_argument("--tls-certificate", dest="tls_certificate")
     p.add_argument("--tls-certificate-key", dest="tls_certificate_key")
@@ -222,7 +229,8 @@ def cmd_check(args) -> int:
 
     bad = 0
     for path in args.paths:
-        if path.endswith(".cache") or path.endswith(".snapshotting"):
+        if path.endswith((".cache", ".snapshotting", ".corrupt")):
+            # .corrupt files are already-quarantined bytes kept for forensics.
             print(f"{path}: skipped")
             continue
         try:

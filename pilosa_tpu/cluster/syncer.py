@@ -48,9 +48,10 @@ class HolderSyncer:
                             self._sync_fragment(
                                 index_name, field_name, view_name, shard, replicas
                             )
-                        except PilosaError as e:
+                        except (PilosaError, OSError) as e:
                             # One fragment's failure (peer down mid-sync, an
-                            # oversized diff rejected) must not abort the
+                            # oversized diff rejected, a local disk fault
+                            # while persisting a merge) must not abort the
                             # rest of the sweep.
                             self.server.logger.error(
                                 "anti-entropy: %s/%s/%s/%s sync failed: %s",
@@ -78,6 +79,15 @@ class HolderSyncer:
         frag = self.holder.fragment(index, field, view, shard)
         if frag is None:
             return
+        if frag.quarantined:
+            # A quarantined fragment booted empty after its file failed
+            # validation. Letting its emptiness vote in the block merge
+            # below could CLEAR acknowledged bits on healthy replicas, so
+            # restore a full copy from a replica first; the normal checksum
+            # walk then runs on repaired data (and pushes nothing, since
+            # local now matches the repair source).
+            if not self._repair_fragment(index, field, view, shard, frag, replicas):
+                return  # no replica could serve a copy; retry next sweep
         local_blocks = {b.id: b.checksum for b in frag.blocks()}
 
         # Gather remote block checksums; union of block ids drives the merge.
@@ -101,6 +111,74 @@ class HolderSyncer:
             if all(c == local_blocks.get(block_id) for c in checksums):
                 continue
             self._merge_block(index, field, view, shard, block_id, frag, remote_blocks)
+
+    def _repair_fragment(self, index, field, view, shard, frag, replicas) -> bool:
+        """Restore a quarantined fragment from the first replica that can
+        ship a full copy (the resize shard-retrieval RPC), folding back any
+        writes acknowledged locally while the fragment served degraded.
+        Returns True when the fragment is whole again."""
+        import io
+
+        for node in replicas:
+            try:
+                data = self.client.retrieve_shard_from_uri(
+                    node, index, field, view, shard
+                )
+            except PilosaError as e:
+                self.server.logger.error(
+                    "anti-entropy: repair pull %s/%s/%s/%s from %s failed: %s",
+                    index, field, view, shard, node.id, e,
+                )
+                continue
+            # Under the fragment's (reentrant) write mutex for the whole
+            # capture -> restore -> fold-back sequence: a write landing
+            # between the local snapshot and read_from's storage swap would
+            # otherwise be silently dropped from the repaired fragment.
+            with frag._mu:
+                # Bits acknowledged AFTER quarantine (the corrupt original
+                # booted empty, so everything currently in storage is
+                # post-quarantine): a full replica restore must not drop
+                # them.
+                local_pos = frag.storage.slice()
+                try:
+                    frag.read_from(io.BytesIO(data))  # clears the quarantine
+                except PilosaError as e:
+                    self.server.logger.error(
+                        "anti-entropy: repair stream %s/%s/%s/%s from %s "
+                        "bad: %s", index, field, view, shard, node.id, e,
+                    )
+                    continue
+                except OSError as e:
+                    if frag.quarantined:
+                        # Failed before the in-memory restore landed.
+                        self.server.logger.error(
+                            "anti-entropy: repair of %s/%s/%s/%s from %s "
+                            "errored: %s", index, field, view, shard,
+                            node.id, e,
+                        )
+                        continue
+                    # The restore DID land (read_from swapped storage and
+                    # cleared the quarantine) — only its trailing snapshot
+                    # failed to persist. Fall through to the fold-back: the
+                    # in-memory state is whole, and bulk_import/next
+                    # snapshot retries persistence.
+                    self.server.logger.error(
+                        "anti-entropy: repaired %s/%s/%s/%s from %s but "
+                        "snapshot persist failed (will retry): %s",
+                        index, field, view, shard, node.id, e,
+                    )
+                if len(local_pos):
+                    rows = local_pos // np.uint64(SHARD_WIDTH)
+                    cols = (local_pos % np.uint64(SHARD_WIDTH)) + np.uint64(
+                        shard * SHARD_WIDTH
+                    )
+                    frag.bulk_import(rows, cols)
+            self.server.logger.info(
+                "anti-entropy: repaired quarantined fragment %s/%s/%s/%s "
+                "from %s", index, field, view, shard, node.id,
+            )
+            return True
+        return False
 
     def _merge_block(self, index, field, view, shard, block_id, frag, remote_blocks) -> None:
         """Pull remote pairs, consensus-merge, push diffs (fragment.go:1737-1809)."""

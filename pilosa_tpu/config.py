@@ -62,6 +62,10 @@ class GossipConfig:
 # See docs/scheduler.md for how the knobs interact.
 from .sched import SchedulerConfig as SchedConfig  # noqa: E402
 
+# Same pattern for [storage]: the durability-policy dataclass lives with
+# the storage layer it governs. See docs/durability.md.
+from .storage import StorageConfig  # noqa: E402
+
 
 @dataclass
 class MetricConfig:
@@ -100,6 +104,7 @@ class Config:
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     gossip: GossipConfig = field(default_factory=GossipConfig)
     scheduler: SchedConfig = field(default_factory=SchedConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     translation: TranslationConfig = field(default_factory=TranslationConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
@@ -151,6 +156,10 @@ class Config:
         self.scheduler.batch_window_max = s.get(
             "batch-window-max", self.scheduler.batch_window_max)
         self.scheduler.batch_max = s.get("batch-max", self.scheduler.batch_max)
+        st = d.get("storage", {})
+        self.storage.fsync = st.get("fsync", self.storage.fsync)
+        self.storage.fsync_batch_ops = st.get(
+            "fsync-batch-ops", self.storage.fsync_batch_ops)
         m = d.get("metric", {})
         self.metric.service = m.get("service", self.metric.service)
         self.metric.host = m.get("host", self.metric.host)
@@ -220,6 +229,13 @@ class Config:
             v = env(name, cast)
             if v is not None:
                 setattr(self.scheduler, attr, v)
+        for attr, name, cast in [
+            ("fsync", "STORAGE_FSYNC", str),
+            ("fsync_batch_ops", "STORAGE_FSYNC_BATCH_OPS", int),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.storage, attr, v)
         v = env("TRANSLATION_PRIMARY_URL", str)
         if v is not None:
             self.translation.primary_url = v
@@ -259,6 +275,8 @@ class Config:
             "sched_batch_window": ("scheduler", "batch_window"),
             "sched_batch_window_max": ("scheduler", "batch_window_max"),
             "sched_batch_max": ("scheduler", "batch_max"),
+            "storage_fsync": ("storage", "fsync"),
+            "storage_fsync_batch_ops": ("storage", "fsync_batch_ops"),
             "translation_primary_url": ("translation", "primary_url"),
             "tls_certificate": ("tls", "certificate_path"),
             "tls_certificate_key": ("tls", "certificate_key_path"),
@@ -318,6 +336,10 @@ class Config:
             f"batch-window-max = {self.scheduler.batch_window_max}",
             f"batch-max = {self.scheduler.batch_max}",
             "",
+            "[storage]",
+            f"fsync = {fmt(self.storage.fsync)}",
+            f"fsync-batch-ops = {self.storage.fsync_batch_ops}",
+            "",
             "[metric]",
             f"service = {fmt(self.metric.service)}",
             f"host = {fmt(self.metric.host)}",
@@ -370,6 +392,7 @@ class Config:
             coordinator_failover_probes=self.gossip.failover_probes,
             internal_key_path=self.gossip.key or None,
             scheduler_config=self.scheduler,
+            storage_config=self.storage.validate(),
         )
         kw.update(overrides)
         return Server(**kw)

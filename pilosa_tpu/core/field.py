@@ -134,6 +134,7 @@ class Field:
         broadcast_shard=None,
         use_sqlite_attrs: bool = True,
         epoch=None,
+        storage_config=None,
     ):
         validate_name(name)
         self.path = path
@@ -143,6 +144,7 @@ class Field:
         self.stats = stats
         self.broadcast_shard = broadcast_shard
         self.epoch = epoch
+        self.storage_config = storage_config
         self.views: Dict[str, View] = {}
         self.bsi_groups: List[BSIGroup] = []
         self._lock = threading.RLock()
@@ -217,6 +219,7 @@ class Field:
             stats=self.stats,
             broadcast_shard=self.broadcast_shard,
             epoch=self.epoch,
+            storage_config=self.storage_config,
         )
 
     def view(self, name: str) -> Optional[View]:

@@ -35,8 +35,10 @@ from ..constants import (
     MAX_OP_N,
     SHARD_WIDTH,
 )
-from ..errors import ColumnRowOutOfRangeError
+from .. import failpoints
+from ..errors import ColumnRowOutOfRangeError, CorruptFragmentError, PilosaError
 from ..ops import bitplane as bp
+from ..storage import FSYNC_ALWAYS, FSYNC_NEVER, StorageConfig
 from ..storage.bitmap import OP_ADD, OP_REMOVE, Bitmap, _as_container, encode_op
 from .cache import NopCache, Pair, new_cache, sort_pairs
 from .row import Row
@@ -121,6 +123,7 @@ class Fragment:
         stats=None,
         max_op_n: int = MAX_OP_N,
         epoch: Optional[WriteEpoch] = None,
+        storage_config: Optional[StorageConfig] = None,
     ):
         self.path = path
         self.index = index
@@ -135,6 +138,19 @@ class Fragment:
 
         self.storage = Bitmap()
         self.op_n = 0
+        self.storage_config = storage_config or StorageConfig()
+        # WAL appends since the last fsync (drives the `batch` fsync mode).
+        self._unsynced_ops = 0
+        # Crash-safety state: quarantined means the on-disk file failed
+        # validation at open — the bad bytes were moved aside to
+        # `<path>.corrupt` (corrupt_path) and this fragment serves/accepts
+        # data from a fresh empty file until anti-entropy repairs it from a
+        # replica. recovered_tail_bytes counts torn WAL bytes discarded by
+        # the last open (0 = the file parsed clean).
+        self.quarantined = False
+        self.corrupt_path: Optional[str] = None
+        self.quarantine_reason: Optional[str] = None
+        self.recovered_tail_bytes = 0
         # Write mutex (reference fragment.go f.mu): the HTTP server applies
         # writes from many threads, and container mutations are multi-step
         # numpy read-modify-write sequences that would otherwise interleave
@@ -157,6 +173,16 @@ class Fragment:
     # ---------------------------------------------------------------- open
 
     def open(self) -> None:
+        failpoints.fire("fragment-open")
+        if self.path:
+            # A leftover .snapshotting temp means a crash mid-snapshot:
+            # the original file (with its op log) is still the durable
+            # truth; the partial rewrite is garbage. Remove it BEFORE
+            # parsing so a later snapshot can't rename torn bytes into
+            # place.
+            tmp = self.path + ".snapshotting"
+            if os.path.exists(tmp):
+                os.remove(tmp)
         if self.path and os.path.exists(self.path):
             size = os.path.getsize(self.path)
             if size:
@@ -169,16 +195,73 @@ class Fragment:
 
                 with open(self.path, "rb") as f:
                     mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-                self.storage = Bitmap.from_buffer(mm, copy=False)
-                self.op_n = self.storage.op_n
+                try:
+                    self.storage = Bitmap.from_buffer(mm, copy=False)
+                except (ValueError, struct.error) as e:
+                    # Includes CorruptFragmentError (a ValueError subclass)
+                    # plus raw numpy/struct failures from mangled payloads.
+                    # One bad fragment must not take the node down: move the
+                    # bytes aside and boot empty; anti-entropy repairs from
+                    # a replica (cluster/syncer.py), and until then queries
+                    # read this fragment as empty.
+                    self._quarantine(e)
+                else:
+                    self.op_n = self.storage.op_n
+                    if self.storage.truncated_bytes:
+                        # Torn WAL tail (crash mid-append): every complete
+                        # op was replayed; cut the file back to the last
+                        # valid record boundary so the garbage can never
+                        # sit between old and future ops.
+                        self.recovered_tail_bytes = self.storage.truncated_bytes
+                        os.truncate(self.path, self.storage.valid_len)
+                        if self.stats:
+                            self.stats.count(
+                                "walTailTruncatedBytes", self.recovered_tail_bytes
+                            )
         if self.path:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
             if not os.path.exists(self.path):
                 with open(self.path, "wb") as f:
                     self.storage.write_to(f)
             self._wal = open(self.path, "ab")
+            if not self.quarantined and os.path.exists(self.path + ".corrupt"):
+                # A .corrupt sibling left by a previous run whose quarantine
+                # was never repaired: the current file holds only the
+                # degraded-period writes, so stay quarantined until
+                # anti-entropy restores the rest from a replica.
+                self.quarantined = True
+                self.corrupt_path = self.path + ".corrupt"
+                self.quarantine_reason = (
+                    f"carried over from previous run ({self.corrupt_path} present)"
+                )
         self._load_cache()
         self._opened = True
+
+    def _quarantine(self, err: Exception) -> None:
+        """Move a corrupt fragment file aside and come up empty (repairable)."""
+        corrupt = self.path + ".corrupt"
+        os.replace(self.path, corrupt)
+        self.quarantined = True
+        self.corrupt_path = corrupt
+        self.storage = Bitmap()
+        self.op_n = 0
+        if self.stats:
+            self.stats.count("fragmentQuarantined", 1)
+        detail = err if isinstance(err, CorruptFragmentError) else repr(err)
+        self.quarantine_reason = str(detail)
+
+    def clear_quarantine(self) -> None:
+        """Called once a repair (replica restore) made local data whole.
+        Removes the .corrupt forensic copy — it doubles as the persistent
+        quarantine marker, so leaving it would re-quarantine on restart."""
+        if self.corrupt_path:
+            try:
+                os.remove(self.corrupt_path)
+            except OSError:
+                pass
+        self.quarantined = False
+        self.corrupt_path = None
+        self.quarantine_reason = None
 
     def close(self) -> None:
         # Under the mutex: closing the WAL out from under a writer inside
@@ -187,6 +270,12 @@ class Fragment:
         with self._mu:
             self._flush_cache()
             if self._wal:
+                if (self._unsynced_ops
+                        and self.storage_config.fsync != FSYNC_NEVER):
+                    # `batch` mode promises a sync at every close boundary.
+                    self._wal.flush()
+                    os.fsync(self._wal.fileno())
+                    self._unsynced_ops = 0
                 self._wal.close()
                 self._wal = None
             self._opened = False
@@ -278,8 +367,17 @@ class Fragment:
 
     def _append_op(self, typ: int, pos: int) -> None:
         if self._wal:
+            failpoints.fire("wal-append")
             self._wal.write(encode_op(typ, pos))
             self._wal.flush()
+            mode = self.storage_config.fsync
+            if mode == FSYNC_ALWAYS:
+                os.fsync(self._wal.fileno())
+            elif mode != FSYNC_NEVER:
+                self._unsynced_ops += 1
+                if self._unsynced_ops >= self.storage_config.fsync_batch_ops:
+                    os.fsync(self._wal.fileno())
+                    self._unsynced_ops = 0
         self.op_n += 1
         if self.op_n >= self.max_op_n:
             self.snapshot()
@@ -728,11 +826,48 @@ class Fragment:
             if self._wal:
                 self._wal.close()
                 self._wal = None
+            durable = self.storage_config.fsync != FSYNC_NEVER
             tmp = self.path + ".snapshotting"
-            with open(tmp, "wb") as f:
-                self.storage.write_to(f)
-            os.replace(tmp, self.path)
+            try:
+                with open(tmp, "wb") as f:
+                    self.storage.write_to(f)
+                    if durable:
+                        # fsync BEFORE rename: os.replace is atomic in the
+                        # namespace but says nothing about data blocks — a
+                        # crash after an un-synced rename can leave the new
+                        # inode empty/torn, losing every op the snapshot
+                        # folded in.
+                        f.flush()
+                        os.fsync(f.fileno())
+                failpoints.fire("snapshot-rename")
+                os.replace(tmp, self.path)
+                if durable:
+                    # Directory fsync: the rename itself must survive power
+                    # loss, or recovery reopens the PRE-snapshot inode
+                    # without the op log that was just folded in and
+                    # truncated away.
+                    dfd = os.open(os.path.dirname(self.path), os.O_RDONLY)
+                    try:
+                        os.fsync(dfd)
+                    finally:
+                        os.close(dfd)
+            except OSError:
+                # Snapshot failed mid-flight (disk fault, injected error).
+                # Whichever inode now sits at self.path — the old file if
+                # the rename didn't happen (its op log intact), the new one
+                # if only the directory fsync failed — is parseable truth:
+                # drop any leftover temp and, critically, restore the
+                # append handle BEFORE re-raising (a None _wal would make
+                # _append_op silently skip WAL logging for every later
+                # acknowledged write).
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                self._wal = open(self.path, "ab")
+                raise
             self.op_n = 0
+            self._unsynced_ops = 0
             self._wal = open(self.path, "ab")
             if self.stats:
                 self.stats.count("snapshot", 1)
@@ -741,14 +876,19 @@ class Fragment:
         return self.path + ".cache" if self.path else None
 
     def _flush_cache(self) -> None:
-        """Persist TopN cache row ids (reference fragment.go:1478-1509)."""
+        """Persist TopN cache row ids (reference fragment.go:1478-1509).
+
+        tmp + os.replace: a crash mid-write must leave either the old cache
+        file or the new one, never a truncated hybrid."""
         path = self.cache_path()
         if not path or isinstance(self.cache, NopCache):
             return
         ids = self.cache.ids()
-        with open(path, "wb") as f:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
             f.write(struct.pack("<I", len(ids)))
             f.write(np.asarray(ids, dtype="<u8").tobytes())
+        os.replace(tmp, path)
 
     def _load_cache(self) -> None:
         path = self.cache_path()
@@ -759,7 +899,13 @@ class Fragment:
         if len(data) < 4:
             return
         (n,) = struct.unpack_from("<I", data, 0)
-        ids = np.frombuffer(data, dtype="<u8", count=n, offset=4)
+        if 4 + 8 * n > len(data):
+            # Truncated cache file (pre-atomic-flush crash): the cache is a
+            # derived structure, so rebuild from storage instead of raising
+            # and failing the whole fragment open.
+            ids = np.asarray(self.rows(), dtype=np.uint64)
+        else:
+            ids = np.frombuffer(data, dtype="<u8", count=n, offset=4)
         for row_id in ids:
             self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
         self.cache.invalidate(force=True)
@@ -778,8 +924,33 @@ class Fragment:
 
     def read_from(self, f) -> None:
         with self._mu:
-            (n,) = struct.unpack("<Q", f.read(8))
-            self.storage = Bitmap.from_bytes(f.read(n))
+            where = self.path or f"{self.index}/{self.field}/{self.view}/{self.shard}"
+            header = f.read(8)
+            if len(header) < 8:
+                raise PilosaError(
+                    f"truncated fragment stream for {where}: expected 8 "
+                    f"header bytes, got {len(header)}"
+                )
+            (n,) = struct.unpack("<Q", header)
+            data = f.read(n)
+            if len(data) < n:
+                raise PilosaError(
+                    f"truncated fragment stream for {where}: expected {n} "
+                    f"payload bytes, got {len(data)}"
+                )
+            bm = Bitmap.from_bytes(data)
+            if bm.truncated_bytes:
+                # A torn op tail is recoverable on a local reopen, but a
+                # SHIPPED stream promising n bytes that don't parse whole is
+                # a transport/sender fault — reject so resize/replication
+                # callers retry rather than silently install partial data.
+                raise PilosaError(
+                    f"torn op log in fragment stream for {where}: "
+                    f"{bm.truncated_bytes} trailing bytes unparseable"
+                )
+            self.storage = bm
+            # A full replica restore makes the local data whole again.
+            self.clear_quarantine()
             self.op_n = 0
             self._plane_cache.clear()
             self._checksums.clear()

@@ -19,15 +19,22 @@ from .index import Index, IndexOptions
 
 
 class Holder:
-    def __init__(self, path: Optional[str] = None, stats=None, broadcast_shard=None):
+    def __init__(self, path: Optional[str] = None, stats=None, broadcast_shard=None,
+                 storage_config=None):
         self.path = path
         self.stats = stats
         self.broadcast_shard = broadcast_shard
+        self.storage_config = storage_config
         self.indexes: Dict[str, Index] = {}
         self._lock = threading.RLock()
         self.opened = False
 
     def open(self) -> "Holder":
+        # Per-fragment corruption is handled BELOW this walk: a fragment
+        # whose file fails validation quarantines itself (bad bytes moved
+        # to .corrupt, boots empty — Fragment._quarantine) instead of
+        # raising, so one bad disk sector can't stop the node from booting.
+        # quarantined_fragments() reports what came up degraded.
         if self.path:
             os.makedirs(self.path, exist_ok=True)
             for name in sorted(os.listdir(self.path)):
@@ -35,7 +42,9 @@ class Holder:
                 if not os.path.isdir(ipath) or name.startswith("."):
                     continue
                 index = Index(
-                    ipath, name, stats=self.stats, broadcast_shard=self.broadcast_shard
+                    ipath, name, stats=self.stats,
+                    broadcast_shard=self.broadcast_shard,
+                    storage_config=self.storage_config,
                 )
                 index.open()
                 self.indexes[name] = index
@@ -77,6 +86,7 @@ class Holder:
             options=options,
             stats=self.stats,
             broadcast_shard=self.broadcast_shard,
+            storage_config=self.storage_config,
         )
         index.open()
         index.save_meta()
@@ -127,6 +137,18 @@ class Holder:
                 )
                 for v_info in f_info.get("views", []):
                     field.create_view_if_not_exists(v_info["name"])
+
+    def quarantined_fragments(self) -> List[Fragment]:
+        """Fragments currently serving degraded (corrupt file moved aside,
+        awaiting anti-entropy repair). Diagnostics and the syncer read this."""
+        out = []
+        for index in list(self.indexes.values()):
+            for field in list(index.fields.values()):
+                for view in list(field.views.values()):
+                    for frag in list(view.fragments.values()):
+                        if frag.quarantined:
+                            out.append(frag)
+        return out
 
     def flush_caches(self) -> None:
         """Persist all TopN caches (reference holder.go:425-461)."""

@@ -39,6 +39,7 @@ class Index:
         options: Optional[IndexOptions] = None,
         stats=None,
         broadcast_shard=None,
+        storage_config=None,
     ):
         validate_name(name)
         self.path = path
@@ -46,6 +47,7 @@ class Index:
         self.options = options or IndexOptions()
         self.stats = stats
         self.broadcast_shard = broadcast_shard
+        self.storage_config = storage_config
         # Index-wide write epoch: every fragment mutation in this index
         # bumps it (core/fragment.py WriteEpoch). The query micro-batcher
         # keys coalescing groups on it so a batch never mixes queries
@@ -82,6 +84,7 @@ class Index:
                     fpath, self.name, fname, stats=self.stats,
                     broadcast_shard=self.broadcast_shard,
                     epoch=self.write_epoch,
+                    storage_config=self.storage_config,
                 )
                 field.open()
                 self.fields[fname] = field
@@ -128,6 +131,7 @@ class Index:
             stats=self.stats,
             broadcast_shard=self.broadcast_shard,
             epoch=self.write_epoch,
+            storage_config=self.storage_config,
         )
         field.open()
         field.save_meta()

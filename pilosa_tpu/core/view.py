@@ -29,6 +29,7 @@ class View:
         stats=None,
         broadcast_shard: Optional[Callable[[str, str, int], None]] = None,
         epoch=None,
+        storage_config=None,
     ):
         self.path = path
         self.index = index
@@ -40,6 +41,7 @@ class View:
         self.stats = stats
         self.broadcast_shard = broadcast_shard
         self.epoch = epoch
+        self.storage_config = storage_config
         self.fragments: Dict[int, Fragment] = {}
         self._lock = threading.RLock()
 
@@ -78,6 +80,7 @@ class View:
             row_attr_store=self.row_attr_store,
             stats=self.stats,
             epoch=self.epoch,
+            storage_config=self.storage_config,
         )
 
     def fragment(self, shard: int) -> Optional[Fragment]:

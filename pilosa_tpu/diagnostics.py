@@ -56,12 +56,18 @@ class DiagnosticsCollector:
             for f in i.fields.values()
             for v in f.views.values()
         )
+        quarantined = holder.quarantined_fragments() if hasattr(
+            holder, "quarantined_fragments") else []
         info = {
             "version": __version__,
             "uptime": int(time.time() - self.start_time),
             "numIndexes": len(holder.indexes),
             "numFields": num_fields,
             "numFragments": num_frags,
+            # Fragments serving degraded after their file failed validation
+            # at open (awaiting anti-entropy repair): a nonzero count means
+            # query results may silently miss this node's share of data.
+            "numQuarantinedFragments": len(quarantined),
             "clusterNodes": len(self.server.cluster.nodes),
             "clusterState": self.server.cluster.state,
             "nodeID": self.server.cluster.node.id,

@@ -89,6 +89,25 @@ class TranslateStoreReadOnlyError(PilosaError):
     message = "translate store is read-only"
 
 
+class CorruptFragmentError(PilosaError, ValueError):
+    """On-disk fragment/bitmap data failed validation (bad cookie, bogus
+    container payload, checksum-failing op record). Carries where the file
+    stopped being trustworthy so quarantine/repair tooling can report it.
+
+    Subclasses ValueError because that's what storage parsing historically
+    raised — callers (and tests) matching ValueError keep working while new
+    callers can catch the typed error and distinguish data corruption from
+    programming errors.
+    """
+
+    message = "corrupt fragment data"
+
+    def __init__(self, *args, path=None, offset=None):
+        super().__init__(*args)
+        self.path = path  # file the bad bytes came from, when known
+        self.offset = offset  # byte offset of the offending record, when known
+
+
 # Name validation (reference: pilosa.go validateName, ^[a-z][a-z0-9_-]{0,63}$).
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,63}$")
 

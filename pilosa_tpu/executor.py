@@ -133,6 +133,11 @@ class Executor:
         # index fast-path queries run as ONE SPMD program over the global
         # mesh instead of the HTTP fan-out; failures fall back to fan-out.
         self.collective = None
+        # Queries touching a quarantined fragment (corrupt file moved
+        # aside at open, not yet repaired by anti-entropy) are served with
+        # that fragment reading as EMPTY rather than erroring — this
+        # counter surfaces how often results were degraded (/debug/vars).
+        self.quarantined_reads = 0
         from .logger import NopLogger
 
         self.logger = NopLogger()  # server wires its logger in open()
@@ -412,6 +417,16 @@ class Executor:
             return self._execute_range_shard(index, c, shard)
         raise QueryError(f"unknown call: {c.name}")
 
+    def _fragment(self, index: str, field: str, view: str, shard: int):
+        """Read-path fragment lookup. A quarantined fragment (corrupt file
+        moved aside at open, repair pending) is returned as-is — its
+        storage is empty, so reads degrade to empty instead of erroring —
+        but the touch is counted so operators can see degraded results."""
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is not None and frag.quarantined:
+            self.quarantined_reads += 1
+        return frag
+
     def _execute_row_shard(self, index: str, c: Call, shard: int) -> Row:
         field_name = c.field_arg()
         fld = self.holder.field(index, field_name)
@@ -420,7 +435,7 @@ class Executor:
         row_id, ok = c.uint_arg(field_name)
         if not ok:
             raise QueryError("Row() must specify row")
-        frag = self.holder.fragment(index, field_name, VIEW_STANDARD, shard)
+        frag = self._fragment(index, field_name, VIEW_STANDARD, shard)
         if frag is None:
             return Row()
         return frag.row(row_id)
@@ -457,7 +472,7 @@ class Executor:
             return Row()
         row = Row()
         for view_name in views_by_time_range(VIEW_STANDARD, start_t, end_t, q):
-            frag = self.holder.fragment(index, field_name, view_name, shard)
+            frag = self._fragment(index, field_name, view_name, shard)
             if frag is not None:
                 row.merge(frag.row(row_id))
         return row
@@ -476,7 +491,7 @@ class Executor:
         bsig = fld.bsi_group(field_name)
         if bsig is None:
             raise BSIGroupNotFoundError(field_name)
-        frag = self.holder.fragment(index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, shard)
+        frag = self._fragment(index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, shard)
 
         if cond.op == NEQ and cond.value is None:  # != null
             return frag.not_null(bsig.bit_depth()) if frag else Row()
@@ -671,7 +686,7 @@ class Executor:
         bsig = fld.bsi_group(field_name)
         if bsig is None:
             return ValCount()
-        frag = self.holder.fragment(index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, shard)
+        frag = self._fragment(index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, shard)
         if frag is None:
             return ValCount()
         if kind == "sum":
@@ -838,7 +853,7 @@ class Executor:
                 union: List[int] = []
                 seen = set()
                 for s in local_shards:
-                    frag = self.holder.fragment(index, field_name, VIEW_STANDARD, s)
+                    frag = self._fragment(index, field_name, VIEW_STANDARD, s)
                     if frag is None:
                         continue
                     cands = frag.top_candidates(topn_opt)
@@ -903,7 +918,7 @@ class Executor:
         elif len(c.children) > 1:
             raise QueryError("TopN() can only have one input bitmap")
 
-        frag = self.holder.fragment(index, field_name, VIEW_STANDARD, shard)
+        frag = self._fragment(index, field_name, VIEW_STANDARD, shard)
         if frag is None:
             return []
         return frag.top(

@@ -1,0 +1,182 @@
+"""Failpoints: deterministic fault injection for crash-safety tests.
+
+A tiny registry of named code points (WAL append, snapshot rename,
+fragment open, client send) that is a no-op in production and lets tests
+inject IO errors or hard crashes at exact moments. Modeled on the
+technique behind Go's gofail / TiKV's failpoint crates: the hook call is
+compiled into the hot path permanently, so the injection points cannot
+rot, and the inactive cost is one module-global boolean check.
+
+Activation:
+  - env:  PILOSA_TPU_FAILPOINTS="wal-append=error;snapshot-rename=1*crash"
+  - code: failpoints.configure("wal-append", "error", count=2)
+
+Spec grammar per point: `[count*]action[(message)]` where action is
+  error  raise InjectedFault (an OSError subclass, so existing IO-error
+         handling paths classify it as a disk fault)
+  crash  os._exit(86) — the moral equivalent of kill -9 at that line;
+         buffers are NOT flushed, finalizers do NOT run
+and `count` limits how many hits trigger (default: unlimited). A point
+whose count is exhausted stays registered but inert, so tests can assert
+`hits(name)` afterward.
+
+Keep `fire()` free of locks and allocation when inactive: it guards on a
+single global bool. The registry mutates under a lock; flipping `_enabled`
+last publishes a fully-built table (CPython attribute stores are atomic).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Dict, Optional
+
+__all__ = [
+    "InjectedFault",
+    "InjectedCrash",
+    "fire",
+    "configure",
+    "activate",
+    "deactivate",
+    "reset",
+    "active",
+    "hits",
+    "CRASH_EXIT_CODE",
+]
+
+# Distinctive exit status so a test supervising a crashed subprocess can
+# tell an injected crash from a real fault.
+CRASH_EXIT_CODE = 86
+
+
+class InjectedFault(OSError):
+    """IO error raised by an `error` failpoint. An OSError so callers'
+    existing disk-fault handling (quarantine, retry, degrade) exercises
+    the same code path a real EIO would."""
+
+
+class InjectedCrash(SystemExit):  # pragma: no cover - never raised, doc only
+    """Placeholder type: `crash` failpoints never raise — they os._exit."""
+
+
+class _Point:
+    __slots__ = ("action", "remaining", "message", "hit_count")
+
+    def __init__(self, action: str, count: Optional[int], message: str):
+        self.action = action
+        self.remaining = count  # None = unlimited
+        self.message = message
+        self.hit_count = 0
+
+
+_enabled = False
+_points: Dict[str, _Point] = {}
+_mu = threading.Lock()
+
+_SPEC_RE = re.compile(
+    r"^(?:(?P<count>\d+)\*)?(?P<action>error|crash)(?:\((?P<msg>[^)]*)\))?$"
+)
+
+
+def fire(name: str) -> None:
+    """The hook threaded through production code. MUST stay cheap when
+    inactive: one global-bool load, no dict lookup, no lock."""
+    if not _enabled:
+        return
+    _fire_slow(name)
+
+
+def _fire_slow(name: str) -> None:
+    with _mu:
+        p = _points.get(name)
+        if p is None:
+            return
+        p.hit_count += 1
+        if p.remaining is not None:
+            if p.remaining <= 0:
+                return
+            p.remaining -= 1
+        action, message = p.action, p.message
+    if action == "crash":
+        # The whole point is to model kill -9: no stack unwinding, no
+        # atexit, no buffer flush. os._exit is the only faithful stand-in.
+        os._exit(CRASH_EXIT_CODE)
+    raise InjectedFault(message or f"injected fault at failpoint {name!r}")
+
+
+def configure(name: str, action: str, count: Optional[int] = None,
+              message: str = "") -> None:
+    """Register (or replace) one failpoint programmatically."""
+    if action not in ("error", "crash"):
+        raise ValueError(f"unknown failpoint action {action!r}")
+    global _enabled
+    with _mu:
+        _points[name] = _Point(action, count, message)
+        _enabled = True
+
+
+def activate(spec: str) -> None:
+    """Parse and register a `name=spec[;name=spec...]` string (the
+    PILOSA_TPU_FAILPOINTS format)."""
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, eq, rhs = part.partition("=")
+        m = _SPEC_RE.match(rhs.strip()) if eq else None
+        if not name.strip() or m is None:
+            raise ValueError(f"bad failpoint spec {part!r} "
+                             "(want name=[count*]action[(message)])")
+        configure(
+            name.strip(),
+            m.group("action"),
+            int(m.group("count")) if m.group("count") else None,
+            m.group("msg") or "",
+        )
+
+
+def deactivate(name: str) -> None:
+    global _enabled
+    with _mu:
+        _points.pop(name, None)
+        if not _points:
+            _enabled = False
+
+
+def reset() -> None:
+    """Drop every registered point (test teardown)."""
+    global _enabled
+    with _mu:
+        _points.clear()
+        _enabled = False
+
+
+def active() -> Dict[str, str]:
+    """name -> action summary, for diagnostics/debug endpoints."""
+    with _mu:
+        return {
+            n: (f"{p.remaining}*{p.action}" if p.remaining is not None
+                else p.action)
+            for n, p in _points.items()
+        }
+
+
+def hits(name: str) -> int:
+    """How many times `fire(name)` reached a registered point."""
+    with _mu:
+        p = _points.get(name)
+        return p.hit_count if p else 0
+
+
+# Env activation at import: the subprocess crash tests set the var before
+# exec'ing the child, so the child's fragments come up armed with no code
+# changes. A bad spec here must not brick server startup half-configured —
+# reset and re-raise so the operator sees the error with a clean registry.
+_env_spec = os.environ.get("PILOSA_TPU_FAILPOINTS")
+if _env_spec:
+    try:
+        activate(_env_spec)
+    except ValueError:
+        reset()
+        raise

@@ -168,6 +168,8 @@ class InternalClient:
         #     and replaying e.g. a create turns success into a conflict.
         # Upper layers own non-idempotent recovery (executor replica
         # retry, member monitor), so surfacing the POST error is correct.
+        from .. import failpoints
+
         for attempt in (0, 1):
             sent = False
             # Starts True so an exception INSIDE _conn (connect refused,
@@ -176,6 +178,10 @@ class InternalClient:
             # freshness once _conn returns (False = pooled keep-alive).
             fresh = True
             try:
+                # Inside the try: an injected send fault (OSError) takes the
+                # SAME classification path as a real one — it is retried
+                # only when the policy below says a real fault would be.
+                failpoints.fire("client-send")
                 conn, fresh = self._conn(parts.scheme, parts.netloc)
                 conn.request(method, path, body=body, headers=headers)
                 sent = True

@@ -510,6 +510,18 @@ class Handler:
             from ..errors import FragmentNotFoundError
 
             raise FragmentNotFoundError("fragment not found")
+        if frag.quarantined:
+            # Serving a quarantined fragment's (empty, degraded) storage as
+            # the real shard would let a resize install the empty copy and
+            # then garbage-collect the healthy replicas — permanent loss.
+            # Erroring makes the resize abort/pick another source and makes
+            # a repairing peer try the next replica.
+            from ..errors import PilosaError
+
+            raise PilosaError(
+                "fragment is quarantined pending repair; refusing to serve "
+                "as a shard source"
+            )
         buf = io.BytesIO()
         frag.write_to(buf)
         return 200, "application/octet-stream", buf.getvalue()
@@ -557,6 +569,26 @@ class Handler:
         if batcher is not None:
             out = dict(out)
             out["batcher"] = batcher.snapshot()
+        # Crash-safety health: which fragments are serving degraded
+        # (quarantined at open, repair pending), how often queries touched
+        # one, and any armed failpoints (nonempty only under fault tests).
+        quarantined = self.api.holder.quarantined_fragments()
+        executor = getattr(self.api, "executor", None)
+        out = dict(out)
+        out["storage"] = {
+            "quarantined": [
+                {
+                    "index": f.index, "field": f.field, "view": f.view,
+                    "shard": f.shard, "reason": f.quarantine_reason,
+                }
+                for f in quarantined
+            ],
+            "quarantined_reads": getattr(executor, "quarantined_reads", 0),
+        }
+        from .. import failpoints as _fp
+
+        if _fp.active():
+            out["failpoints"] = _fp.active()
         return out
 
     _profile_lock = threading.Lock()

@@ -58,6 +58,7 @@ class Server:
         coordinator_failover_probes: int = 3,
         internal_key_path: Optional[str] = None,
         scheduler_config=None,
+        storage_config=None,
         join_addr: Optional[str] = None,
         allowed_origins: Optional[List[str]] = None,
         tls_certificate: Optional[str] = None,
@@ -107,6 +108,7 @@ class Server:
             os.path.join(data_dir, "indexes") if data_dir else None,
             stats=self.stats,
             broadcast_shard=self._on_new_shard,
+            storage_config=storage_config,
         )
         self.translate_store = TranslateStore(
             os.path.join(data_dir, "keys") if data_dir else None,

@@ -1,0 +1,43 @@
+"""Storage backends: roaring bitmap persistence + durability policy."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+FSYNC_NEVER = "never"
+FSYNC_BATCH = "batch"
+FSYNC_ALWAYS = "always"
+FSYNC_MODES = (FSYNC_NEVER, FSYNC_BATCH, FSYNC_ALWAYS)
+
+
+# The [storage] config section IS this dataclass (same pattern as
+# [scheduler]/SchedulerConfig): one source of truth for knob names and
+# defaults. Threaded Holder -> Index -> Field -> View -> Fragment, like the
+# per-index write epoch.
+@dataclass
+class StorageConfig:
+    """Durability policy for the fragment WAL + snapshot path.
+
+    fsync:
+      never   flush to the OS page cache only (survives process kill -9,
+              loses acknowledged writes on machine power loss)
+      batch   fsync the WAL every `fsync_batch_ops` appends and at every
+              snapshot/close boundary — bounded loss window, near-`never`
+              throughput (the default)
+      always  fsync after every op append — zero acknowledged-write loss,
+              pays a disk flush per write
+    Snapshots fsync the temp file before rename and the directory after,
+    in every mode except `never`.
+    """
+
+    fsync: str = FSYNC_BATCH
+    fsync_batch_ops: int = 64
+
+    def validate(self) -> "StorageConfig":
+        if self.fsync not in FSYNC_MODES:
+            raise ValueError(
+                f"storage.fsync must be one of {FSYNC_MODES}, got {self.fsync!r}"
+            )
+        if self.fsync_batch_ops < 1:
+            raise ValueError("storage.fsync-batch-ops must be >= 1")
+        return self

@@ -31,6 +31,8 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from ..errors import CorruptFragmentError
+
 MAGIC_NUMBER = 12348
 STORAGE_VERSION = 0
 COOKIE = MAGIC_NUMBER + (STORAGE_VERSION << 16)
@@ -168,7 +170,7 @@ class Container:
         if real != self.n:
             # Leave nv False so EVERY touch keeps raising — a caller that
             # catches one error must not get silently-poisoned counts next.
-            raise ValueError(
+            raise CorruptFragmentError(
                 f"corrupt bitmap container: header cardinality {self.n} != "
                 f"payload popcount {real}"
             )
@@ -641,12 +643,17 @@ class _ContainerMap(MutableMapping):
 class Bitmap:
     """Two-form-container bitmap over uint64 values."""
 
-    __slots__ = ("containers", "op_n", "_skeys")
+    __slots__ = ("containers", "op_n", "_skeys", "valid_len", "truncated_bytes")
 
     def __init__(self, values=None):
         # key (value >> 16) -> Container of low 16 bits
         self.containers = _ContainerMap(_CONTAINER_FACTORY(), self._inval_keys)
         self.op_n = 0
+        # Torn-tail recovery bookkeeping, set by from_buffer: byte length of
+        # the last valid record boundary, and how many trailing bytes past
+        # it were discarded (0 = the whole buffer parsed clean).
+        self.valid_len = 0
+        self.truncated_bytes = 0
         self._skeys: Optional[np.ndarray] = None  # sorted key cache
         if values is not None:
             self.add_many(np.asarray(values, dtype=np.uint64))
@@ -965,34 +972,51 @@ class Bitmap:
         (Container._mutable_bits). The views keep `data` alive."""
         b = cls()
         if len(data) < HEADER_BASE_SIZE:
-            raise ValueError("data too small")
+            raise CorruptFragmentError("data too small", offset=0)
         magic = struct.unpack_from("<H", data, 0)[0]
         version = struct.unpack_from("<H", data, 2)[0]
         if magic != MAGIC_NUMBER:
-            raise ValueError(f"invalid roaring file, magic number {magic}")
+            raise CorruptFragmentError(
+                f"invalid roaring file, magic number {magic}", offset=0)
         if version != STORAGE_VERSION:
-            raise ValueError(f"wrong roaring version {version}")
+            raise CorruptFragmentError(
+                f"wrong roaring version {version}", offset=2)
         key_n = struct.unpack_from("<I", data, 4)[0]
 
+        # The container region is written atomically (snapshot tmp+rename),
+        # so ANY structural damage here — short headers, wild offsets, bad
+        # payloads — is corruption, not a torn append: raise, don't truncate.
         headers = []
         pos = HEADER_BASE_SIZE
-        for _ in range(key_n):
-            key, typ, n_minus_1 = struct.unpack_from("<QHH", data, pos)
-            headers.append((key, typ, n_minus_1 + 1))
-            pos += 12
-        offsets = struct.unpack_from(f"<{key_n}I", data, pos) if key_n else ()
+        try:
+            for _ in range(key_n):
+                key, typ, n_minus_1 = struct.unpack_from("<QHH", data, pos)
+                headers.append((key, typ, n_minus_1 + 1))
+                pos += 12
+            offsets = struct.unpack_from(f"<{key_n}I", data, pos) if key_n else ()
+        except struct.error as e:
+            raise CorruptFragmentError(
+                f"truncated container header region: {e}", offset=pos) from e
         ops_offset = pos + 4 * key_n
 
         for (key, typ, n), off in zip(headers, offsets):
             if off >= len(data):
-                raise ValueError(f"offset out of bounds: off={off}, len={len(data)}")
+                raise CorruptFragmentError(
+                    f"offset out of bounds: off={off}, len={len(data)}",
+                    offset=off)
             if typ == CONTAINER_ARRAY:
+                if off + 2 * n > len(data):
+                    raise CorruptFragmentError(
+                        f"array payload out of bounds at key {key}", offset=off)
                 arr = np.frombuffer(data, dtype="<u2", count=n, offset=off)
                 if copy:
                     arr = arr.astype(np.uint16)
                 c = Container(arr=arr, n=n)
                 ops_offset = max(ops_offset, off + 2 * n)
             elif typ == CONTAINER_BITMAP:
+                if off + 8 * BITMAP_N > len(data):
+                    raise CorruptFragmentError(
+                        f"bitset payload out of bounds at key {key}", offset=off)
                 words = np.frombuffer(data, dtype="<u8", count=BITMAP_N, offset=off)
                 # Dense containers stay bitsets — no value-list round trip.
                 # In copy mode cardinality is derived from the payload so a
@@ -1009,7 +1033,13 @@ class Bitmap:
                     c.nv = False
                 ops_offset = max(ops_offset, off + 8 * BITMAP_N)
             elif typ == CONTAINER_RUN:
+                if off + 2 > len(data):
+                    raise CorruptFragmentError(
+                        f"run header out of bounds at key {key}", offset=off)
                 run_n = struct.unpack_from("<H", data, off)[0]
+                if off + 2 + 4 * run_n > len(data):
+                    raise CorruptFragmentError(
+                        f"run payload out of bounds at key {key}", offset=off)
                 runs = np.frombuffer(
                     data, dtype="<u2", count=2 * run_n, offset=off + 2
                 ).reshape(run_n, 2)
@@ -1028,9 +1058,10 @@ class Bitmap:
                     if np.any(l < s) or (
                         run_n > 1 and np.any(s[1:] <= l[:-1])
                     ):
-                        raise ValueError(
+                        raise CorruptFragmentError(
                             f"corrupt run container at key {key}: intervals "
-                            "inverted, unsorted, or overlapping"
+                            "inverted, unsorted, or overlapping",
+                            offset=off,
                         )
                     if copy:
                         runs = runs.astype(np.uint16)
@@ -1038,15 +1069,38 @@ class Bitmap:
                 n = c.n
                 ops_offset = max(ops_offset, off + 2 + 4 * run_n)
             else:
-                raise ValueError(f"unknown container type {typ}")
+                raise CorruptFragmentError(
+                    f"unknown container type {typ}", offset=off)
             if n:
                 b.containers[key] = c
 
-        # Replay trailing op log (reference roaring.go:2889-2953).
+        # Replay trailing op log (reference roaring.go:2889-2953) with
+        # torn-tail recovery: a crash mid-append leaves a short or
+        # checksum-failing record at the END of the log — stop there and
+        # report the discard; every fully-appended op before it is
+        # preserved, and the caller (fragment open) truncates the file back
+        # to valid_len so the torn bytes never poison a later append. A
+        # checksum failure with MORE data beyond the record is different:
+        # appends only ever tear the final record, so a bad mid-log record
+        # is bit rot — raise (quarantine + replica repair) rather than
+        # silently truncating away every acknowledged op after it.
         while ops_offset < len(data):
-            b.apply_op(*parse_op(data, ops_offset))
+            if len(data) - ops_offset < OP_SIZE:
+                break  # incomplete trailing record
+            try:
+                op = parse_op(data, ops_offset)
+            except CorruptFragmentError:
+                if len(data) - ops_offset > OP_SIZE:
+                    raise CorruptFragmentError(
+                        "op checksum failure mid-log (not a torn tail)",
+                        offset=ops_offset,
+                    )
+                break  # corrupt FINAL record: a torn append
+            b.apply_op(*op)
             b.op_n += 1
             ops_offset += OP_SIZE
+        b.valid_len = ops_offset
+        b.truncated_bytes = len(data) - ops_offset
         return b
 
     def apply_op(self, typ: int, value: int) -> bool:
@@ -1089,9 +1143,10 @@ def encode_op(typ: int, value: int) -> bytes:
 
 def parse_op(data: bytes, offset: int = 0) -> Tuple[int, int]:
     if len(data) - offset < OP_SIZE:
-        raise ValueError(f"op data out of bounds: len={len(data) - offset}")
+        raise CorruptFragmentError(
+            f"op data out of bounds: len={len(data) - offset}", offset=offset)
     typ, value = struct.unpack_from("<BQ", data, offset)
     chk = struct.unpack_from("<I", data, offset + 9)[0]
     if chk != fnv32a(data[offset : offset + 9]):
-        raise ValueError("checksum mismatch")
+        raise CorruptFragmentError("op checksum mismatch", offset=offset)
     return typ, value
