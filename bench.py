@@ -32,10 +32,17 @@ TPU / 0.125 on CPU), BENCH_BIG_{SHARDS,ROWS,ITERS} (HBM-resident headline
 stanza; default 256x128 = 4 GiB on TPU / 16x32 on CPU),
 BENCH_CHILD_MIN_S (minimum window worth handing to a TPU child, default
 420), and
-BENCH_{HBM,BIG,SCALE,OPEN,IMPORT,SERVING,SCHED,TOPN_BSI,TIME_RANGE}=0
+BENCH_{HBM,BIG,SCALE,OPEN,IMPORT,SERVING,SCHED,TOPN_BSI,TIME_RANGE,MIXED}=0
 to skip a stanza (the Pallas-vs-XLA kernel race lives inside the HBM
 stanza; SCHED measures the query scheduler's cross-query micro-batching
-— dispatches/query with >= 8 concurrent clients).
+— dispatches/query with >= 8 concurrent clients; MIXED measures the
+delta-refresh path under interleaved writes+reads, delta on vs off).
+
+BENCH_SMOKE=1 runs EVERY stanza at micro scale on the CPU backend (no
+probe subprocesses, second-scale workloads): it validates that the bench
+itself executes end-to-end and emits a parseable JSON line — the tier-1
+smoke test runs it at PR time so bench breakage is caught before a
+measurement round burns its deadline on it.
 """
 
 import json
@@ -45,6 +52,14 @@ import sys
 import time
 
 import numpy as np
+
+# Micro-scale mode: every stanza shrinks its workload and its timed-loop
+# floors so the full suite completes in seconds. Scale knobs that already
+# have env overrides are defaulted in main(); hardcoded stanza constants
+# consult this flag directly.
+SMOKE = os.environ.get("BENCH_SMOKE") == "1"
+# (min loop iterations, min timed seconds) for the open-ended timing loops.
+_LOOP_MIN, _LOOP_SECS = (2, 0.05) if SMOKE else (3, 1.5)
 
 
 # ------------------------------------------------------- backend bring-up
@@ -183,6 +198,7 @@ def bench_device(ex, n_rows, n_shards, iters):
     # and host<->device transfer overlap (a serving loop with concurrent
     # clients does exactly this).
     depth = int(os.environ.get("BENCH_PIPELINE", "4"))
+    min_batches, min_secs = (2, 0.05) if SMOKE else (8, 1.0)
     done = 0
     inflight = []
     start = time.perf_counter()
@@ -191,7 +207,7 @@ def bench_device(ex, n_rows, n_shards, iters):
         if len(inflight) >= depth:
             np.asarray(inflight.pop(0))
             done += iters
-        if done >= 8 * iters and time.perf_counter() - start > 1.0:
+        if done >= min_batches * iters and time.perf_counter() - start > min_secs:
             break
     for r in inflight:
         np.asarray(r)
@@ -199,7 +215,7 @@ def bench_device(ex, n_rows, n_shards, iters):
     count_qps = done / (time.perf_counter() - start)
 
     start = time.perf_counter()
-    topn_iters = max(3, min(iters // 4, 32))
+    topn_iters = 2 if SMOKE else max(3, min(iters // 4, 32))
     for _ in range(topn_iters):
         ex.execute("bench", "TopN(f, n=5)")
     topn_qps = topn_iters / (time.perf_counter() - start)
@@ -234,7 +250,7 @@ def bench_host(holder, n_rows, n_shards, iters):
         }
         done = 0
         start = time.perf_counter()
-        while done < 3 or time.perf_counter() - start < 1.5:
+        while done < _LOOP_MIN or time.perf_counter() - start < _LOOP_SECS:
             a, b = done % n_rows, (done + 1) % n_rows
             total = 0
             for pa, pb in zip(planes[a], planes[b]):
@@ -250,7 +266,7 @@ def bench_host(holder, n_rows, n_shards, iters):
     cache = {row: [host_row(f, row) for f in frags] for row in range(n_rows)}
     done = 0
     start = time.perf_counter()
-    while done < 3 or time.perf_counter() - start < 1.5:
+    while done < _LOOP_MIN or time.perf_counter() - start < _LOOP_SECS:
         a, b = done % n_rows, (done + 1) % n_rows
         total = 0
         for sa, sb in zip(cache[a], cache[b]):
@@ -327,7 +343,7 @@ def bench_hbm():
     u = max(16, int(gib * 2**30 / (s * w * 4)))
     u = -(-u // 8) * 8  # multiple of 8: the stack builds in 8 donated chunks
     q = min(1024, u)
-    r = 16
+    r = 2 if SMOKE else 16
     out = {"stack_gib": round(u * s * w * 4 / 2**30, 3),
            "shape": [u, s, w], "batch_q": q, "loop_r": r}
 
@@ -364,7 +380,7 @@ def bench_hbm():
             got = int(fn())
             compile_s = time.perf_counter() - t0
             best = 1e9
-            for _ in range(3):
+            for _ in range(1 if SMOKE else 3):
                 t0 = time.perf_counter()
                 int(fn())
                 best = min(best, time.perf_counter() - t0)
@@ -453,7 +469,7 @@ def bench_scale():
     from pilosa_tpu.parallel.engine import ShardedQueryEngine
     from pilosa_tpu.pql.parser import parse
 
-    n_rows, n_shards = 192, 4
+    n_rows, n_shards = (24, 2) if SMOKE else (192, 4)
     plane_bytes = n_shards * WORDS_PER_ROW * 4
     budget = (n_rows // 2) * plane_bytes  # half the touched set fits
 
@@ -625,7 +641,7 @@ def bench_big():
     assert int(warm[0]) == want, f"big count mismatch: {int(warm[0])} != {want}"
 
     t0 = time.perf_counter()
-    reps = 4
+    reps = 1 if SMOKE else 4
     for _ in range(reps):
         np.asarray(engine.count_batch_async("big", calls, shards))
     dt = time.perf_counter() - t0
@@ -656,7 +672,7 @@ def bench_big():
 
     done = 0
     t0 = time.perf_counter()
-    while done < 3 or time.perf_counter() - t0 < 2.0:
+    while done < _LOOP_MIN or time.perf_counter() - t0 < (0.1 if SMOKE else 2.0):
         host_once(done % len(host_pairs))
         done += 1
     host_qps = done / (time.perf_counter() - t0)
@@ -673,7 +689,7 @@ def bench_big():
 
     next_topn()  # compile + stack build
     t0 = time.perf_counter()
-    reps = 6
+    reps = 2 if SMOKE else 6
     for _ in range(reps):
         next_topn()
     out["topn_qps_device"] = round(reps / (time.perf_counter() - t0), 2)
@@ -722,7 +738,7 @@ def bench_serving():
     from pilosa_tpu.server.client import InternalClient
     from pilosa_tpu.server.server import Server
 
-    n_rows, n_clients, per_client = 32, 48, 12
+    n_rows, n_clients, per_client = (8, 6, 3) if SMOKE else (32, 48, 12)
     rng = np.random.default_rng(11)
     out = {}
     for label, memo in (("memo_off", "0"), ("memo_on", "8192")):
@@ -784,7 +800,7 @@ def bench_sched():
     from pilosa_tpu.server.client import InternalClient
     from pilosa_tpu.server.server import Server
 
-    n_rows, n_clients, per_client = 16, 16, 16
+    n_rows, n_clients, per_client = (8, 4, 4) if SMOKE else (16, 16, 16)
     rng = np.random.default_rng(23)
     out = {}
     prev_memo = os.environ.get("PILOSA_MEMO_ENTRIES")
@@ -851,6 +867,110 @@ def bench_sched():
     return out
 
 
+# --------------------------------------------- mixed read/write stanza
+
+
+def bench_mixed():
+    """Mixed ingest+serve — the delta-refresh tentpole's target regime:
+    batched Counts over a resident leaf stack while a deterministic write
+    stream dirties the planes (writes_per_batch single-bit sets applied
+    between query batches, round-robin over resident rows, so both runs
+    see byte-identical traffic). Reports qps and bytes moved host->device
+    with the delta path on (default) vs forced off
+    (PILOSA_TPU_ENGINE_DELTA_MAX_FRACTION=0: every write costs a full plane walk +
+    re-upload + restack). The win condition is bytes_to_device collapsing
+    by orders of magnitude at equal-or-better qps."""
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.parallel.engine import ShardedQueryEngine
+    from pilosa_tpu.pql.parser import parse
+
+    n_shards, n_rows, reps = (2, 8, 4) if SMOKE else (8, 32, 24)
+    writes_per_batch = int(os.environ.get("BENCH_MIXED_WRITES", "4"))
+    rng = np.random.default_rng(17)
+    holder = Holder(None)
+    holder.open()
+    idx = holder.create_index("mix")
+    fld = idx.create_field("f")
+    rows, cols = [], []
+    for row in range(n_rows):
+        for shard in range(n_shards):
+            c = rng.choice(SHARD_WIDTH, size=1024, replace=False)
+            rows.append(np.full(1024, row, dtype=np.uint64))
+            cols.append(c.astype(np.uint64) + np.uint64(shard * SHARD_WIDTH))
+    fld.import_bits(np.concatenate(rows), np.concatenate(cols))
+    shards = list(range(n_shards))
+    iters = min(n_rows * (n_rows - 1), 64)
+    pairs = _distinct_pairs(n_rows, iters)
+    calls = [
+        parse(f"Count(Intersect(Row(f={a}), Row(f={b})))").calls[0].children[0]
+        for a, b in pairs
+    ]
+    out = {"shards": n_shards, "rows": n_rows, "batches": reps,
+           "writes_per_batch": writes_per_batch, "batch_q": iters}
+    prev = os.environ.get("PILOSA_TPU_ENGINE_DELTA_MAX_FRACTION")
+    # One monotone write stream ACROSS both runs: re-setting an already-set
+    # bit is a no-op (no generation bump), so a per-run counter would hand
+    # the second run a write stream of phantoms and zero cache churn.
+    wcol = {"i": 0}
+
+    def write_burst():
+        for k in range(writes_per_batch):
+            wcol["i"] += 1
+            fld.set_bit(wcol["i"] % n_rows,
+                        (wcol["i"] * 7919) % SHARD_WIDTH)
+
+    try:
+        for label, frac in (("delta_on", None), ("delta_off", "0")):
+            if frac is None:
+                os.environ.pop("PILOSA_TPU_ENGINE_DELTA_MAX_FRACTION", None)
+            else:
+                os.environ["PILOSA_TPU_ENGINE_DELTA_MAX_FRACTION"] = frac
+            engine = ShardedQueryEngine(holder)
+
+            # Warm: build the resident stack, compile the count AND the
+            # delta-scatter programs so the timed loop is steady state.
+            np.asarray(engine.count_batch_async("mix", calls, shards))
+            write_burst()
+            np.asarray(engine.count_batch_async("mix", calls, shards))
+            base = dict(engine.counters)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                write_burst()
+                np.asarray(engine.count_batch_async("mix", calls, shards))
+            dt = time.perf_counter() - t0
+            moved = (engine.counters["delta_bytes"]
+                     + engine.counters["full_refresh_bytes"]
+                     - base["delta_bytes"] - base["full_refresh_bytes"])
+            engine.close()  # release the cold-gather thread pool
+            out[label] = {
+                "qps": round(reps * iters / dt, 1),
+                "bytes_to_device": int(moved),
+                "delta_bytes": engine.counters["delta_bytes"] - base["delta_bytes"],
+                "leaf_delta_hits":
+                    engine.counters["leaf_delta_hits"] - base["leaf_delta_hits"],
+                "stack_delta_hits":
+                    engine.counters["stack_delta_hits"] - base["stack_delta_hits"],
+                "full_refresh_bytes":
+                    engine.counters["full_refresh_bytes"]
+                    - base["full_refresh_bytes"],
+            }
+    finally:
+        if prev is None:
+            os.environ.pop("PILOSA_TPU_ENGINE_DELTA_MAX_FRACTION", None)
+        else:
+            os.environ["PILOSA_TPU_ENGINE_DELTA_MAX_FRACTION"] = prev
+    holder.close()
+    on, off = out["delta_on"], out["delta_off"]
+    out["bytes_ratio_off_over_on"] = round(
+        off["bytes_to_device"] / max(on["bytes_to_device"], 1), 1)
+    out["qps_ratio_on_over_off"] = round(
+        on["qps"] / max(off["qps"], 1e-9), 2)
+    out["delta_ok"] = (on["bytes_to_device"] < off["bytes_to_device"]
+                       and on["stack_delta_hits"] > 0)
+    return out
+
+
 # ------------------------------------------------------- import stanza
 
 
@@ -869,7 +989,7 @@ def bench_import():
     out = {}
     with tempfile.TemporaryDirectory() as d:
         # Random scatter: n_rows x bits_per_row over the full shard width.
-        n_rows, per_row = 64, 80_000
+        n_rows, per_row = (8, 4000) if SMOKE else (64, 80_000)
         rows = np.repeat(np.arange(n_rows, dtype=np.uint64), per_row)
         cols = rng.integers(0, SHARD_WIDTH, rows.size, dtype=np.uint64)
         f = Fragment(os.path.join(d, "rand"), "i", "f", "standard", 0)
@@ -940,9 +1060,9 @@ def bench_topn_bsi():
     from pilosa_tpu.executor import Executor
     from pilosa_tpu.pql.parser import parse
 
-    n_shards, n_rows = 8, 256
-    bits_per_row_shard = 4096
-    vals_per_shard = 65536
+    n_shards, n_rows = (2, 32) if SMOKE else (8, 256)
+    bits_per_row_shard = 512 if SMOKE else 4096
+    vals_per_shard = 2048 if SMOKE else 65536
     rng = np.random.default_rng(5)
 
     holder = Holder(None)
@@ -981,7 +1101,7 @@ def bench_topn_bsi():
         cyc["i"] += 1
         return ex.execute("ns3", f"TopN(f, Row(f={3 + cyc['i'] % 16}), n=10)")
 
-    out["topn_qps_device"] = round(_qps(next_topn, 8), 2)
+    out["topn_qps_device"] = round(_qps(next_topn, 2 if SMOKE else 8), 2)
 
     # Host: per-fragment candidate top with numpy popcount intersections
     # (cache candidates -> plane AND+popcount per shard).
@@ -1009,7 +1129,7 @@ def bench_topn_bsi():
     host_pairs = host_topn()
     assert [(p.id, p.count) for p in host_pairs] == \
         [(p.id, p.count) for p in device_topn[:10]], "topn host/device diverge"
-    out["topn_qps_host"] = round(_qps(host_topn, 4), 2)
+    out["topn_qps_host"] = round(_qps(host_topn, 2 if SMOKE else 4), 2)
     out["topn_vs_host"] = round(out["topn_qps_device"] / out["topn_qps_host"], 2)
 
     # --- BSI Sum/Min/Max under a Row filter (device: one batched program
@@ -1026,7 +1146,7 @@ def bench_topn_bsi():
             return ex.execute(
                 "ns3", f"{kname}(Row(f={3 + kcyc['i'] % 16}), field=v)")
 
-        out[f"{kind}_qps_device"] = round(_qps(next_val, 8), 2)
+        out[f"{kind}_qps_device"] = round(_qps(next_val, 2 if SMOKE else 8), 2)
 
         filter_call = parse("Row(f=3)").calls[0]
 
@@ -1056,7 +1176,7 @@ def bench_topn_bsi():
         host_result = host_val()
         if kind == "sum":
             assert host_result[0] + host_result[1] * bsig.min == device_val.val
-        out[f"{kind}_qps_host"] = round(_qps(host_val, 4), 2)
+        out[f"{kind}_qps_host"] = round(_qps(host_val, 2 if SMOKE else 4), 2)
         out[f"{kind}_vs_host"] = round(
             out[f"{kind}_qps_device"] / out[f"{kind}_qps_host"], 2)
     holder.close()
@@ -1072,8 +1192,8 @@ def bench_time_range():
     from pilosa_tpu.core.holder import Holder
     from pilosa_tpu.executor import Executor
 
-    n_shards, n_rows, n_days = 4, 32, 30
-    bits_per_day = 512
+    n_shards, n_rows, n_days = (2, 8, 10) if SMOKE else (4, 32, 30)
+    bits_per_day = 64 if SMOKE else 512
     rng = np.random.default_rng(13)
     holder = Holder(None)
     holder.open()
@@ -1114,7 +1234,7 @@ def bench_time_range():
         state["i"] += 1
         return ex.execute("ns4", q)
 
-    out["range_count_qps_device"] = round(_qps(next_window, 8), 2)
+    out["range_count_qps_device"] = round(_qps(next_window, 2 if SMOKE else 8), 2)
 
     # Host: numpy OR of the day-view planes, popcounted.
     from pilosa_tpu.timeq import views_by_time_range
@@ -1136,7 +1256,7 @@ def bench_time_range():
         return total
 
     assert host_range() == device_count, "range host/device diverge"
-    out["range_count_qps_host"] = round(_qps(host_range, 4), 2)
+    out["range_count_qps_host"] = round(_qps(host_range, 2 if SMOKE else 4), 2)
     out["range_vs_host"] = round(
         out["range_count_qps_device"] / out["range_count_qps_host"], 2)
 
@@ -1146,7 +1266,7 @@ def bench_time_range():
     pairs = ex.execute("ns4", q_topn)[0]
     assert pairs and all(p.id % 2 == 0 for p in pairs)
     out["attr_topn_qps_device"] = round(
-        _qps(lambda: ex.execute("ns4", q_topn), 8), 2)
+        _qps(lambda: ex.execute("ns4", q_topn), 2 if SMOKE else 8), 2)
     holder.close()
     return out
 
@@ -1169,7 +1289,8 @@ def bench_open():
         path = os.path.join(d, "frag.0")
         f = Fragment(path, "i", "f", "standard", 0)
         f.open()
-        n_rows, bits_per_row = 64, 160_000  # dense bitset containers
+        # dense bitset containers
+        n_rows, bits_per_row = (8, 20_000) if SMOKE else (64, 160_000)
         rows = np.repeat(np.arange(n_rows, dtype=np.uint64), bits_per_row)
         cols = rng.integers(0, SHARD_WIDTH, rows.size, dtype=np.uint64)
         f.bulk_import(rows, cols)
@@ -1267,6 +1388,19 @@ def main():
     if deadline > 0:
         threading.Thread(target=watchdog, daemon=True).start()
 
+    if SMOKE:
+        # Micro-scale everything and pin the CPU backend: smoke validates
+        # that the bench EXECUTES (every stanza, parseable JSON line), not
+        # what the hardware measures — probing a tunnel would burn minutes.
+        for k, v in (
+            ("BENCH_FORCE_PLATFORM", "cpu"), ("BENCH_SHARDS", "2"),
+            ("BENCH_ROWS", "8"), ("BENCH_ITERS", "16"),
+            ("BENCH_HBM_GIB", "0.002"), ("BENCH_BIG_SHARDS", "2"),
+            ("BENCH_BIG_ROWS", "8"), ("BENCH_BIG_ITERS", "8"),
+            ("BENCH_PIPELINE", "2"),
+        ):
+            os.environ.setdefault(k, v)
+
     n_shards = int(os.environ.get("BENCH_SHARDS", "8"))
     n_rows = int(os.environ.get("BENCH_ROWS", "128"))
     density = float(os.environ.get("BENCH_DENSITY", "0.02"))
@@ -1291,6 +1425,7 @@ def main():
     platform = None
     tpu_up = threading.Event()
     stop_prober = threading.Event()
+    prober_started = False
     # Set when a TPU answered only on an EXPLICIT platform name (the
     # default-platform override is dead): the child run gets pinned to it.
     tpu_platform_arg = {"explicit": None}
@@ -1370,6 +1505,8 @@ def main():
         print("bench: default backend unavailable; benchmarking CPU now and "
               "re-probing the tunnel in the background", file=sys.stderr)
         if not is_child:
+            prober_started = True
+
             def prober():
                 n = 1
                 while not stop_prober.wait(90):
@@ -1436,7 +1573,8 @@ def main():
     open_stanza = stanza("OPEN", bench_open)
     import_stanza = stanza("IMPORT", bench_import)
     serving = stanza("SERVING", bench_serving)
-    stanza("SCHED", bench_sched)
+    sched = stanza("SCHED", bench_sched)
+    mixed = stanza("MIXED", bench_mixed)
     topn_bsi = stanza("TOPN_BSI", bench_topn_bsi)
     time_range = stanza("TIME_RANGE", bench_time_range)
 
@@ -1460,7 +1598,11 @@ def main():
     # unparseable output — the CPU line below still prints, with the
     # failure recorded in it.
     child_error = None
-    if platform == "cpu" and not is_child:
+    if platform == "cpu" and not is_child and prober_started:
+        # prober_started gates the wait: a FORCED cpu run (or one whose
+        # prober already gave up) has nobody setting tpu_up, and waiting
+        # out the deadline for it burned ~30 min of every forced-cpu /
+        # smoke round as pure sleep.
         min_child = float(os.environ.get("BENCH_CHILD_MIN_S", "420"))
         while not tpu_up.is_set():
             left = deadline - (time.time() - t_start)
@@ -1545,6 +1687,10 @@ def main():
             "open": open_stanza,
             "import": import_stanza,
             "serving": serving,
+            # sched/mixed were only reachable via checkpoint lines before;
+            # the driver parses the LAST line, so they must ride it too.
+            "sched": sched,
+            "mixed": mixed,
             "topn_bsi": topn_bsi,
             "time_range": time_range,
             **extra,

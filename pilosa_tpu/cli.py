@@ -69,6 +69,18 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                         "(sync per write)")
     p.add_argument("--storage-fsync-batch-ops", dest="storage_fsync_batch_ops",
                    type=int, help="ops between WAL fsyncs in batch mode")
+    p.add_argument("--engine-delta-max-fraction",
+                   dest="engine_delta_max_fraction", type=float,
+                   help="max changed fraction of a resident device tensor "
+                        "refreshed by a scattered delta (0 disables deltas)")
+    p.add_argument("--engine-delta-journal-ops",
+                   dest="engine_delta_journal_ops", type=int,
+                   help="per-fragment dirty-word journal bound; overflow "
+                        "falls back to full cache regathers")
+    p.add_argument("--engine-gather-workers", dest="engine_gather_workers",
+                   type=int,
+                   help="threads for cold-path per-shard plane gathers "
+                        "(0 = auto)")
     p.add_argument("--translation-primary-url", dest="translation_primary_url")
     p.add_argument("--tls-certificate", dest="tls_certificate")
     p.add_argument("--tls-certificate-key", dest="tls_certificate_key")

@@ -66,6 +66,11 @@ from .sched import SchedulerConfig as SchedConfig  # noqa: E402
 # the storage layer it governs. See docs/durability.md.
 from .storage import StorageConfig  # noqa: E402
 
+# And for [engine]: the device-cache refresh knobs live with the parallel
+# engine (pilosa_tpu/parallel/__init__.py, jax-free so CLI startup stays
+# light). See docs/engine-caches.md.
+from .parallel import EngineConfig  # noqa: E402
+
 
 @dataclass
 class MetricConfig:
@@ -105,6 +110,7 @@ class Config:
     gossip: GossipConfig = field(default_factory=GossipConfig)
     scheduler: SchedConfig = field(default_factory=SchedConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     translation: TranslationConfig = field(default_factory=TranslationConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
@@ -160,6 +166,13 @@ class Config:
         self.storage.fsync = st.get("fsync", self.storage.fsync)
         self.storage.fsync_batch_ops = st.get(
             "fsync-batch-ops", self.storage.fsync_batch_ops)
+        e = d.get("engine", {})
+        self.engine.delta_max_fraction = e.get(
+            "delta-max-fraction", self.engine.delta_max_fraction)
+        self.engine.delta_journal_ops = e.get(
+            "delta-journal-ops", self.engine.delta_journal_ops)
+        self.engine.gather_workers = e.get(
+            "gather-workers", self.engine.gather_workers)
         m = d.get("metric", {})
         self.metric.service = m.get("service", self.metric.service)
         self.metric.host = m.get("host", self.metric.host)
@@ -236,6 +249,14 @@ class Config:
             v = env(name, cast)
             if v is not None:
                 setattr(self.storage, attr, v)
+        for attr, name, cast in [
+            ("delta_max_fraction", "ENGINE_DELTA_MAX_FRACTION", float),
+            ("delta_journal_ops", "ENGINE_DELTA_JOURNAL_OPS", int),
+            ("gather_workers", "ENGINE_GATHER_WORKERS", int),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.engine, attr, v)
         v = env("TRANSLATION_PRIMARY_URL", str)
         if v is not None:
             self.translation.primary_url = v
@@ -277,6 +298,9 @@ class Config:
             "sched_batch_max": ("scheduler", "batch_max"),
             "storage_fsync": ("storage", "fsync"),
             "storage_fsync_batch_ops": ("storage", "fsync_batch_ops"),
+            "engine_delta_max_fraction": ("engine", "delta_max_fraction"),
+            "engine_delta_journal_ops": ("engine", "delta_journal_ops"),
+            "engine_gather_workers": ("engine", "gather_workers"),
             "translation_primary_url": ("translation", "primary_url"),
             "tls_certificate": ("tls", "certificate_path"),
             "tls_certificate_key": ("tls", "certificate_key_path"),
@@ -340,6 +364,11 @@ class Config:
             f"fsync = {fmt(self.storage.fsync)}",
             f"fsync-batch-ops = {self.storage.fsync_batch_ops}",
             "",
+            "[engine]",
+            f"delta-max-fraction = {self.engine.delta_max_fraction}",
+            f"delta-journal-ops = {self.engine.delta_journal_ops}",
+            f"gather-workers = {self.engine.gather_workers}",
+            "",
             "[metric]",
             f"service = {fmt(self.metric.service)}",
             f"host = {fmt(self.metric.host)}",
@@ -393,6 +422,7 @@ class Config:
             internal_key_path=self.gossip.key or None,
             scheduler_config=self.scheduler,
             storage_config=self.storage.validate(),
+            engine_config=self.engine,
         )
         kw.update(overrides)
         return Server(**kw)

@@ -135,6 +135,7 @@ class Field:
         use_sqlite_attrs: bool = True,
         epoch=None,
         storage_config=None,
+        delta_journal_ops=None,
     ):
         validate_name(name)
         self.path = path
@@ -145,6 +146,7 @@ class Field:
         self.broadcast_shard = broadcast_shard
         self.epoch = epoch
         self.storage_config = storage_config
+        self.delta_journal_ops = delta_journal_ops
         self.views: Dict[str, View] = {}
         self.bsi_groups: List[BSIGroup] = []
         self._lock = threading.RLock()
@@ -220,6 +222,7 @@ class Field:
             broadcast_shard=self.broadcast_shard,
             epoch=self.epoch,
             storage_config=self.storage_config,
+            delta_journal_ops=self.delta_journal_ops,
         )
 
     def view(self, name: str) -> Optional[View]:

@@ -18,6 +18,7 @@ Behavioral port of /root/reference/fragment.go re-architected TPU-first:
 from __future__ import annotations
 
 import heapq
+import itertools
 import os
 import struct
 import threading
@@ -48,6 +49,26 @@ import hashlib
 # TopN batched intersection-count chunk (rows per device call).
 TOPN_BATCH = 256
 
+# Dirty-word journal bound (total recorded words per fragment). The journal
+# is what makes device-cache refresh cost proportional to the WRITE, not the
+# plane (parallel/engine.py delta path); past this many un-consumed entries
+# it resets and the next refresh of each cached row falls back to a full
+# regather. Env default (same name as the [engine] config section's env
+# override — ONE spelling per knob); per-Fragment override rides the
+# Holder -> Index -> Field -> View chain like StorageConfig.
+DELTA_JOURNAL_OPS = int(
+    os.environ.get("PILOSA_TPU_ENGINE_DELTA_JOURNAL_OPS", "4096"))
+
+# Process-wide incarnation ids for Fragment and WriteEpoch instances.
+# Generations and epochs RESET when an index/fragment is deleted and
+# recreated under the same name, while the engine's caches (keyed by name)
+# survive — a recreated counter that climbs back to a cached value would
+# alias a stale entry as fresh (or, worse, let a partial delta patch the
+# OLD object's plane). Pairing every counter with an instance-unique
+# incarnation makes cross-incarnation values never compare equal.
+# itertools.count.__next__ is atomic under CPython's GIL.
+_INCARNATION = itertools.count(1)
+
 
 def _block_hasher():
     """THE merkle block digest (one definition for the streaming blocks()
@@ -76,10 +97,13 @@ class WriteEpoch:
     could collide a batch key with one seen before a write burst. Reads
     are a bare attribute load — a torn read is impossible for an int."""
 
-    __slots__ = ("value", "_mu")
+    __slots__ = ("value", "incarnation", "_mu")
 
     def __init__(self):
         self.value = 0
+        # See _INCARNATION: lets epoch-keyed memo entries distinguish a
+        # recreated index whose fresh counter climbed back to an old value.
+        self.incarnation = next(_INCARNATION)
         self._mu = threading.Lock()
 
     def bump(self) -> None:
@@ -124,6 +148,7 @@ class Fragment:
         max_op_n: int = MAX_OP_N,
         epoch: Optional[WriteEpoch] = None,
         storage_config: Optional[StorageConfig] = None,
+        delta_journal_ops: Optional[int] = None,
     ):
         self.path = path
         self.index = index
@@ -165,10 +190,35 @@ class Fragment:
         self._opened = False
         # Bumped on every mutation; lets the sharded query engine know when
         # its device-resident leaf tensors are stale (parallel/engine.py).
+        # Paired with `incarnation` in engine fingerprints so a recreated
+        # fragment's fresh counter can never alias a stale cache entry.
         self.generation = 0
+        self.incarnation = next(_INCARNATION)
         # Index-level write epoch (see WriteEpoch), bumped alongside
         # generation so O(1) index staleness reads need no fragment walk.
         self.epoch = epoch
+        # Dirty-word journal. The engine's delta-refresh path asks
+        # dirty_words_since(row, cached_gen) to upload only the changed
+        # words of a stale resident plane instead of re-walking and
+        # re-shipping the whole (S, W) tensor. Bounded by delta_journal_ops
+        # unique dirty words; overflow or a bulk mutation without word info
+        # poisons the affected rows (floor dicts) so stale readers fall
+        # back to a full regather — never to a partial delta.
+        self.delta_journal_ops = (
+            DELTA_JOURNAL_OPS if delta_journal_ops is None else delta_journal_ops
+        )
+        # row -> {w64: generation of its LAST mutation}. A dict, not an
+        # append log: re-writing a hot word updates its generation in
+        # place, so the journal is bounded by UNIQUE dirty words — an
+        # append log overflowed (and forced a full-regather storm) every
+        # delta_journal_ops writes under sustained single-word churn, the
+        # exact regime the delta path serves.
+        self._dirty: Dict[int, Dict[int, int]] = {}
+        self._dirty_n = 0
+        # Per-row completeness floor: deltas are answerable only for cached
+        # generations >= max(row floor, fragment floor).
+        self._dirty_floor: Dict[int, int] = {}
+        self._dirty_floor_all = 0
 
     # ---------------------------------------------------------------- open
 
@@ -332,12 +382,76 @@ class Fragment:
 
     # --------------------------------------------------------------- writes
 
-    def _invalidate_row(self, row_id: int) -> None:
+    def _invalidate_row(self, row_id: int, dirty_w64=None) -> None:
+        """Invalidate caches for one mutated row. EVERY mutation path must
+        come through here (or read_from's whole-fragment equivalent): the
+        generation bump is what stale-proofs the engine's device caches and
+        the epoch bump is what stale-proofs the batcher's group keys and
+        the memo's O(1) probe — a path that skips either serves stale
+        results silently (tests/test_delta.py parametrizes the audit).
+
+        `dirty_w64` is the iterable of changed 64-bit word indices within
+        the row plane; None means the caller can't enumerate them (bulk
+        storage ops), which poisons this row's journal so the next delta
+        probe falls back to a full regather."""
         self._plane_cache.pop(row_id, None)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         self.generation += 1
+        if dirty_w64 is None or SHARD_WIDTH % 64:
+            dropped = self._dirty.pop(row_id, None)
+            if dropped:
+                self._dirty_n -= len(dropped)
+            self._dirty_floor[row_id] = self.generation
+            if len(self._dirty_floor) > max(self.delta_journal_ops, 1):
+                self._journal_reset()
+        else:
+            g = self.generation
+            d = self._dirty.setdefault(row_id, {})
+            for w in dirty_w64:
+                w = int(w)
+                if w not in d:
+                    self._dirty_n += 1
+                d[w] = g
+            if self._dirty_n > self.delta_journal_ops:
+                self._journal_reset()
         if self.epoch is not None:
             self.epoch.bump()
+
+    def _journal_reset(self) -> None:
+        """Drop all delta history: any cached generation older than NOW can
+        no longer be delta-refreshed (returns None => full regather)."""
+        self._dirty.clear()
+        self._dirty_n = 0
+        self._dirty_floor.clear()
+        self._dirty_floor_all = self.generation
+
+    def dirty_words_since(self, row_id: int, gen: int):
+        """64-bit word indices (within the row plane) mutated after
+        generation `gen`, or None when the journal can't answer (overflow,
+        bulk mutation, or `gen` from a previous fragment incarnation) and
+        the caller must fall back to a full plane regather. An EMPTY array
+        means the generation churn came from OTHER rows of this fragment —
+        the cached plane for this row is still byte-exact."""
+        with self._mu:
+            if gen > self.generation:
+                # A generation from a prior incarnation of this fragment
+                # (reopen resets the counter): history is unknowable.
+                return None
+            floor = max(self._dirty_floor.get(row_id, 0), self._dirty_floor_all)
+            if gen < floor:
+                return None
+            d = self._dirty.get(row_id)
+            if not d:
+                return np.empty(0, dtype=np.int64)
+            words = [w for w, g in d.items() if g > gen]
+            return np.array(words, dtype=np.int64)
+
+    def row_words64(self, row_id: int, w64: np.ndarray) -> np.ndarray:
+        """Current uint64 word values of the row plane at the given 64-bit
+        word indices — O(touched containers), not O(plane): the host-side
+        read half of a delta refresh."""
+        base = (row_id * SHARD_WIDTH) >> 6
+        return self.storage.words64(np.asarray(w64, dtype=np.int64) + base)
 
     def set_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
@@ -346,7 +460,7 @@ class Fragment:
             if not changed:
                 return False
             self._append_op(OP_ADD, pos)
-            self._invalidate_row(row_id)
+            self._invalidate_row(row_id, ((pos % SHARD_WIDTH) >> 6,))
             self.cache.add(row_id, self.row_count(row_id))
         if self.stats:
             self.stats.count("setBit", 1)
@@ -359,7 +473,7 @@ class Fragment:
             if not changed:
                 return False
             self._append_op(OP_REMOVE, pos)
-            self._invalidate_row(row_id)
+            self._invalidate_row(row_id, ((pos % SHARD_WIDTH) >> 6,))
             self.cache.add(row_id, self.row_count(row_id))
         if self.stats:
             self.stats.count("clearBit", 1)
@@ -765,11 +879,16 @@ class Fragment:
             return
         self.storage.add_many(add_pos)
         self.storage.remove_many(rem_pos)
-        touched = np.unique(
-            np.concatenate([add_pos, rem_pos]) // np.uint64(SHARD_WIDTH)
-        )
-        for row_id in touched:
-            self._invalidate_row(int(row_id))
+        allpos = np.concatenate([add_pos, rem_pos])
+        rows = allpos // np.uint64(SHARD_WIDTH)
+        # Anti-entropy fold-back stays delta-refreshable: the diff positions
+        # ARE the dirty words (journaled unless the diff alone would blow
+        # the journal bound).
+        w64s = (allpos % np.uint64(SHARD_WIDTH)) >> np.uint64(6)
+        journal = len(allpos) <= self.delta_journal_ops
+        for row_id in np.unique(rows):
+            words = np.unique(w64s[rows == row_id]) if journal else None
+            self._invalidate_row(int(row_id), words)
             self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
         self.cache.invalidate(force=True)
         self.snapshot()
@@ -785,8 +904,15 @@ class Fragment:
         )
         with self._mu:
             self.storage.add_many(positions)
+            # Imports small enough to journal keep resident planes
+            # delta-refreshable (positions overapproximate: an already-set
+            # bit journals a word that didn't change — extra words are
+            # re-read, never wrong). Big imports poison the touched rows.
+            journal = len(positions) <= self.delta_journal_ops
+            w64s = (positions % np.uint64(SHARD_WIDTH)) >> np.uint64(6)
             for row_id in np.unique(row_ids):
-                self._invalidate_row(int(row_id))
+                words = np.unique(w64s[row_ids == row_id]) if journal else None
+                self._invalidate_row(int(row_id), words)
                 self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
             self.cache.invalidate(force=True)
             self.snapshot()
@@ -798,6 +924,11 @@ class Fragment:
         with self._mu:
             column_ids = np.asarray(column_ids, dtype=np.uint64) % np.uint64(SHARD_WIDTH)
             values = np.asarray(values, dtype=np.uint64)
+            # Every bit plane's changed words are a subset of the imported
+            # columns' words — one overapproximation journals all planes.
+            w_all = np.unique(column_ids >> np.uint64(6))
+            journal = len(w_all) * (bit_depth + 1) <= self.delta_journal_ops
+            words = w_all if journal else None
             for i in range(bit_depth):
                 mask = (values >> np.uint64(i)) & np.uint64(1)
                 on = column_ids[mask == 1]
@@ -805,9 +936,9 @@ class Fragment:
                 base = np.uint64(i * SHARD_WIDTH)
                 self.storage.add_many(on + base)
                 self.storage.remove_many(off + base)
-                self._invalidate_row(i)
+                self._invalidate_row(i, words)
             self.storage.add_many(column_ids + np.uint64(bit_depth * SHARD_WIDTH))
-            self._invalidate_row(bit_depth)
+            self._invalidate_row(bit_depth, words)
             self.snapshot()
 
     # ---------------------------------------------------------- persistence
@@ -956,6 +1087,9 @@ class Fragment:
             self._checksums.clear()
             self.cache.clear()
             self.generation += 1
+            # Wholesale replacement: no per-word history exists, so every
+            # cached generation older than NOW must full-regather.
+            self._journal_reset()
             if self.epoch is not None:
                 self.epoch.bump()
             for row_id in self.rows():

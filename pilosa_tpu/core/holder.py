@@ -20,11 +20,12 @@ from .index import Index, IndexOptions
 
 class Holder:
     def __init__(self, path: Optional[str] = None, stats=None, broadcast_shard=None,
-                 storage_config=None):
+                 storage_config=None, delta_journal_ops=None):
         self.path = path
         self.stats = stats
         self.broadcast_shard = broadcast_shard
         self.storage_config = storage_config
+        self.delta_journal_ops = delta_journal_ops
         self.indexes: Dict[str, Index] = {}
         self._lock = threading.RLock()
         self.opened = False
@@ -45,6 +46,7 @@ class Holder:
                     ipath, name, stats=self.stats,
                     broadcast_shard=self.broadcast_shard,
                     storage_config=self.storage_config,
+                    delta_journal_ops=self.delta_journal_ops,
                 )
                 index.open()
                 self.indexes[name] = index
@@ -87,6 +89,7 @@ class Holder:
             stats=self.stats,
             broadcast_shard=self.broadcast_shard,
             storage_config=self.storage_config,
+            delta_journal_ops=self.delta_journal_ops,
         )
         index.open()
         index.save_meta()

@@ -40,6 +40,7 @@ class Index:
         stats=None,
         broadcast_shard=None,
         storage_config=None,
+        delta_journal_ops=None,
     ):
         validate_name(name)
         self.path = path
@@ -48,6 +49,7 @@ class Index:
         self.stats = stats
         self.broadcast_shard = broadcast_shard
         self.storage_config = storage_config
+        self.delta_journal_ops = delta_journal_ops
         # Index-wide write epoch: every fragment mutation in this index
         # bumps it (core/fragment.py WriteEpoch). The query micro-batcher
         # keys coalescing groups on it so a batch never mixes queries
@@ -85,6 +87,7 @@ class Index:
                     broadcast_shard=self.broadcast_shard,
                     epoch=self.write_epoch,
                     storage_config=self.storage_config,
+                    delta_journal_ops=self.delta_journal_ops,
                 )
                 field.open()
                 self.fields[fname] = field
@@ -132,6 +135,7 @@ class Index:
             broadcast_shard=self.broadcast_shard,
             epoch=self.write_epoch,
             storage_config=self.storage_config,
+            delta_journal_ops=self.delta_journal_ops,
         )
         field.open()
         field.save_meta()
@@ -144,6 +148,11 @@ class Index:
             if field is None:
                 raise FieldNotFoundError(name)
             field.close()
+            # Dropping a field changes what every query over this index can
+            # see — without the bump, the memo's O(1) epoch fast path would
+            # keep serving counts memoized against the deleted field's
+            # fragments (a recreated same-name field shares this epoch).
+            self.write_epoch.bump()
             if field.path and os.path.isdir(field.path):
                 shutil.rmtree(field.path)
 

@@ -30,6 +30,7 @@ class View:
         broadcast_shard: Optional[Callable[[str, str, int], None]] = None,
         epoch=None,
         storage_config=None,
+        delta_journal_ops=None,
     ):
         self.path = path
         self.index = index
@@ -42,6 +43,7 @@ class View:
         self.broadcast_shard = broadcast_shard
         self.epoch = epoch
         self.storage_config = storage_config
+        self.delta_journal_ops = delta_journal_ops
         self.fragments: Dict[int, Fragment] = {}
         self._lock = threading.RLock()
 
@@ -81,6 +83,7 @@ class View:
             stats=self.stats,
             epoch=self.epoch,
             storage_config=self.storage_config,
+            delta_journal_ops=self.delta_journal_ops,
         )
 
     def fragment(self, shard: int) -> Optional[Fragment]:

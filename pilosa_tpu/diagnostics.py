@@ -85,6 +85,19 @@ class DiagnosticsCollector:
             snap = batcher.snapshot()
             info["schedBatchLaunches"] = snap.get("launches", 0)
             info["schedBatchCoalesced"] = snap.get("coalesced", 0)
+        # Delta-refresh health under mixed read/write traffic: a deployment
+        # whose deltaBytes stays tiny next to fullRefreshBytes is keeping
+        # its HBM caches warm through writes; the inverse means writes are
+        # forcing full plane re-uploads (journal overflow / bulk ingest).
+        # Peek the lazy engine slot only — gathering diagnostics must never
+        # be what first opens the device backend.
+        engine = getattr(getattr(self.server, "executor", None), "_engine", None)
+        if engine is not None:
+            c = engine.counters
+            info["engineLeafDeltaHits"] = c.get("leaf_delta_hits", 0)
+            info["engineStackDeltaHits"] = c.get("stack_delta_hits", 0)
+            info["engineDeltaBytes"] = c.get("delta_bytes", 0)
+            info["engineFullRefreshBytes"] = c.get("full_refresh_bytes", 0)
         info.update(system_info())
         info.update(self._extra)
         return info

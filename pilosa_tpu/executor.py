@@ -113,10 +113,14 @@ class Executor:
         translate_store=None,
         max_writes_per_request: int = MAX_WRITES_PER_REQUEST,
         workers: int = 8,
+        engine_config=None,
     ):
         from .cluster.node import Cluster
 
         self.holder = holder
+        # Device-engine knobs (parallel.EngineConfig); held here because
+        # the engine itself is constructed lazily on first device use.
+        self.engine_config = engine_config
         self.cluster = cluster or Cluster()
         self.client = client
         self.translate_store = translate_store
@@ -147,13 +151,16 @@ class Executor:
         if self._engine is None:
             from .parallel.engine import ShardedQueryEngine
 
-            self._engine = ShardedQueryEngine(self.holder)
+            self._engine = ShardedQueryEngine(
+                self.holder, config=self.engine_config)
         return self._engine
 
     def close(self) -> None:
-        """Release serving resources (thread pool)."""
+        """Release serving resources (thread pools)."""
         if self._pool is not None:
             self._pool.shutdown(wait=False)
+        if self._engine is not None:
+            self._engine.close()
 
 
     @property

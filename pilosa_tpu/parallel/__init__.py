@@ -1,0 +1,30 @@
+"""Sharded device query engine: mesh placement, compiled-program and
+device-tensor caches, delta refresh, multi-host collectives."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+# The [engine] config section IS this dataclass (same pattern as
+# [scheduler]/SchedulerConfig and [storage]/StorageConfig). It lives in the
+# package __init__ — NOT engine.py — so config.py can import it without
+# pulling jax into every CLI startup. Env vars (PILOSA_TPU_ENGINE_*, same
+# spellings config.py maps for this section) override per-process.
+@dataclass
+class EngineConfig:
+    """Device-cache refresh knobs for ShardedQueryEngine.
+
+    delta_max_fraction: a stale resident plane/stack is refreshed by a
+        small scattered update (indices+values host->HBM) only while the
+        changed 32-bit words stay under this fraction of the tensor;
+        past it the full regather path wins. 0 disables the delta path.
+    delta_journal_ops: per-fragment dirty-word journal bound
+        (core/fragment.py); overflow falls back to full regather.
+    gather_workers: threads for the cold-path per-shard host container
+        walks (0 = auto-size to the CPU count, 1 = serial).
+    """
+
+    delta_max_fraction: float = 0.25
+    delta_journal_ops: int = 4096
+    gather_workers: int = 0

@@ -371,8 +371,12 @@ class CollectiveBackend:
         return buf
 
     def _leaf_fingerprint(self, index: str, leaf, my_shards: List[int]) -> Tuple:
+        # (incarnation, generation) pairs, as in engine._fingerprint: a
+        # deleted-and-recreated index resets generation counters while this
+        # name-keyed cache survives, and a bare counter climbing back to a
+        # cached value would alias the old index's stale plane.
         return tuple(
-            -1 if f is None else f.generation
+            -1 if f is None else (f.incarnation, f.generation)
             for f in (
                 self.holder.fragment(index, leaf.field, leaf.view, s)
                 for s in my_shards

@@ -29,6 +29,7 @@ from ..core.row import Row
 from ..errors import FieldNotFoundError, BSIGroupNotFoundError, QueryError
 from ..ops import bitplane as bp
 from ..pql.ast import BETWEEN, Call, GT, GTE, LT, LTE, NEQ
+from . import EngineConfig
 from .mesh import SHARD_AXIS, default_mesh, pad_shards, shard_sharding
 
 
@@ -225,9 +226,31 @@ class _Compiler:
 
 
 class ShardedQueryEngine:
-    def __init__(self, holder, mesh=None):
+    def __init__(self, holder, mesh=None, config: Optional[EngineConfig] = None):
         self.holder = holder
         self.mesh = mesh if mesh is not None else default_mesh()
+        if config is None:
+            # No resolved config (library/test/bench use): honor the env
+            # spellings directly. When a Config DID resolve these knobs,
+            # flags > env > TOML precedence already happened there —
+            # re-reading env here would let a stray export silently beat
+            # an explicit --engine-* flag.
+            config = EngineConfig(
+                delta_max_fraction=float(os.environ.get(
+                    "PILOSA_TPU_ENGINE_DELTA_MAX_FRACTION",
+                    EngineConfig.delta_max_fraction)),
+                gather_workers=int(os.environ.get(
+                    "PILOSA_TPU_ENGINE_GATHER_WORKERS",
+                    EngineConfig.gather_workers)),
+            )
+        # Delta-refresh budget: a stale resident tensor is refreshed by a
+        # scattered (indices, values) upload only while the changed 32-bit
+        # words stay under this fraction of the tensor; 0 disables deltas.
+        self._delta_max_fraction = float(config.delta_max_fraction)
+        # Cold-gather host parallelism (per-shard container walks).
+        gw = int(config.gather_workers)
+        self._gather_workers = gw if gw > 0 else min(8, os.cpu_count() or 1)
+        self._gather_pool = None  # lazy ThreadPoolExecutor
         # (index, leaf, shards) -> (generation fingerprint, sharded device array)
         self._leaf_cache: Dict[Tuple, Tuple[Tuple, jax.Array]] = {}
         self._leaf_bytes = 0
@@ -285,6 +308,14 @@ class ShardedQueryEngine:
             # scheduler's coalescing proof is dispatches/query < 1, so the
             # counters must distinguish a launch from an answered query.
             "count_dispatches": 0, "bitmap_dispatches": 0,
+            # Delta-refresh accounting: delta hits refreshed a stale
+            # resident tensor with a scattered update (delta_bytes of
+            # host->device traffic) instead of a full host walk + re-upload
+            # (full_refresh_bytes counts those). The bench MIXED stanza's
+            # win condition is delta_bytes << full_refresh_bytes at equal
+            # correctness under mixed read/write traffic.
+            "leaf_delta_hits": 0, "stack_delta_hits": 0,
+            "delta_bytes": 0, "full_refresh_bytes": 0,
         }
 
     def stack_generation(self, index: str) -> int:
@@ -298,6 +329,15 @@ class ShardedQueryEngine:
     def _count_dispatch(self) -> None:
         with self._lock:
             self.counters["count_dispatches"] += 1
+
+    def close(self) -> None:
+        """Release host-side serving resources (the cold-gather thread
+        pool — its workers are non-daemon, so an embedder that opens and
+        closes executors repeatedly would otherwise leak them)."""
+        with self._lock:
+            pool, self._gather_pool = self._gather_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     # ------------------------------------------------------------ caches
     #
@@ -401,10 +441,14 @@ class ShardedQueryEngine:
     # --------------------------------------------------------- leaf tensors
 
     def _fingerprint(self, index: str, leaf: Leaf, shards: Tuple[int, ...]) -> Tuple:
-        """Per-shard fragment generations for one leaf — the staleness key
-        for every device cache (no device work, just holder lookups)."""
+        """Per-shard (incarnation, generation) pairs for one leaf — the
+        staleness key for every device cache (no device work, just holder
+        lookups). The incarnation half makes a RECREATED fragment (deleted
+        index re-made under the same name, generation counter reset) never
+        compare equal to a stale entry, even if its fresh counter climbs
+        back to the cached value."""
         return tuple(
-            -1 if f is None else f.generation
+            -1 if f is None else (f.incarnation, f.generation)
             for f in (
                 self.holder.fragment(index, leaf.field, leaf.view, s)
                 for s in shards
@@ -418,7 +462,8 @@ class ShardedQueryEngine:
         frags = [
             self.holder.fragment(index, leaf.field, leaf.view, s) for s in shards
         ]
-        fingerprint = tuple(-1 if f is None else f.generation for f in frags)
+        fingerprint = tuple(
+            -1 if f is None else (f.incarnation, f.generation) for f in frags)
 
         def probe():
             with self._lock:
@@ -433,13 +478,20 @@ class ShardedQueryEngine:
         if arr is not None:
             return arr
         try:
-            buf = np.zeros((s_padded, WORDS_PER_ROW), dtype=np.uint32)
-            for i, frag in enumerate(frags):
-                if frag is not None:
-                    buf[i] = frag.plane_np(leaf.row)
+            # Stale resident entry: try the delta path first — upload only
+            # the words the writes changed instead of re-walking every
+            # shard's containers and re-shipping the whole plane.
+            with self._lock:
+                stale = self._leaf_cache.get(key)
+            if stale is not None:
+                arr = self._leaf_delta(key, leaf.row, stale, frags, fingerprint)
+                if arr is not None:
+                    return arr
+            buf = self._host_gather(frags, leaf.row, s_padded)
             arr = jax.device_put(buf, shard_sharding(self.mesh, 2))
             with self._lock:
                 self.counters["leaf_misses"] += 1
+                self.counters["full_refresh_bytes"] += buf.nbytes
                 self._leaf_bytes = self._byte_cache_put(
                     self._leaf_cache, key, (fingerprint, arr),
                     self._leaf_budget, self._leaf_bytes, "leaf_evictions",
@@ -447,6 +499,212 @@ class ShardedQueryEngine:
         finally:
             self._release(("leaf", key))
         return arr
+
+    # ------------------------------------------------------- cold gather
+
+    def _pool(self):
+        with self._lock:
+            if self._gather_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._gather_pool = ThreadPoolExecutor(
+                    max_workers=self._gather_workers,
+                    thread_name_prefix="pilosa-gather",
+                )
+            return self._gather_pool
+
+    def _host_gather(self, frags, row: int, s_padded: int) -> np.ndarray:
+        """Cold-path host assembly of an (S_padded, W) plane buffer. The
+        per-shard container walks are independent pure reads (fragment
+        reads are lock-free by design), so they thread-pool; the device
+        transfer of leaf k overlaps leaf k+1's walk for free via jax async
+        dispatch, so no cross-leaf pipeline is needed on top."""
+        buf = np.zeros((s_padded, WORDS_PER_ROW), dtype=np.uint32)
+        live = [(i, f) for i, f in enumerate(frags) if f is not None]
+        if len(live) > 1 and self._gather_workers > 1:
+            def fill(item):
+                i, frag = item
+                buf[i] = frag.plane_np(row)
+
+            list(self._pool().map(fill, live))
+        else:
+            for i, frag in live:
+                buf[i] = frag.plane_np(row)
+        return buf
+
+    # ------------------------------------------------------ delta refresh
+    #
+    # A write to a resident fragment bumps its generation; without deltas
+    # the next query pays a full host container walk over EVERY shard of
+    # the leaf plus a full (S, W) re-upload and a restack of every (U, S,
+    # W) stack containing it — O(plane) work for a 1-bit write. The dirty-
+    # word journal (core/fragment.py) lets stale members report exactly
+    # which 64-bit words changed; while the total stays under
+    # delta_max_fraction of the tensor, the refresh is a small (indices,
+    # values) device_put + one jitted scatter into the cached array.
+    #
+    # The scatter is functional (.at[].set builds a new on-device array),
+    # NOT buffer-donating: concurrent readers that probed before the write
+    # may still be dispatching programs against the old buffer, and
+    # donation would invalidate it under them. The on-device copy is HBM-
+    # bandwidth cheap; the win is eliminating the host walk and the
+    # host->device plane transfer.
+
+    def _collect_updates(self, members, size: int):
+        """Shared delta collector for the leaf and stack paths (one body so
+        the guards/budget/incarnation logic cannot diverge between them).
+
+        `members`: iterable of (coords, frag, row, old_fp, new_fp) per
+        STALE cache member — coords are the member's leading indices in the
+        cached tensor ((shard,) for a leaf, (u, shard) for a stack), fps
+        are -1 or (incarnation, generation) pairs. `size` is the cached
+        tensor's element count (the delta budget base).
+
+        Returns None when only a full regather is safe (missing fragment,
+        fragment recreated since the fp was read, journal can't answer,
+        budget exceeded), else a list of (coords, col32 indices, uint32
+        values) triples — possibly empty, meaning the generation churn came
+        from rows outside the cache and zero bytes need to move."""
+        out = []
+        n32 = 0
+        for coords, frag, row, old_fp, new_fp in members:
+            if frag is None or old_fp == -1 or new_fp == -1:
+                return None
+            if old_fp[0] != new_fp[0] or frag.incarnation != new_fp[0]:
+                # Different incarnation: the journal's generations are not
+                # comparable across it (and the frag we just looked up may
+                # itself be newer than the fingerprint we read).
+                return None
+            w = frag.dirty_words_since(row, old_fp[1])
+            if w is None:
+                return None
+            if not len(w):
+                continue
+            n32 += 2 * len(w)
+            if n32 > self._delta_max_fraction * size:
+                return None
+            cols, vals = self._updates32(w, frag.row_words64(row, w))
+            out.append((coords, cols, vals))
+        return out
+
+    @staticmethod
+    def _updates32(w64: np.ndarray, v64: np.ndarray):
+        """Expand 64-bit dirty words into the (col32 indices, uint32
+        values) pairs of the device plane layout. The interleave matches
+        plane_np's `.view(np.uint32)` on the same host, so the scattered
+        words are byte-identical to a regathered plane."""
+        cols = np.empty(2 * len(w64), dtype=np.int32)
+        cols[0::2] = w64 * 2
+        cols[1::2] = w64 * 2 + 1
+        return cols, v64.view(np.uint32)
+
+    @staticmethod
+    def _pad_updates(arrays):
+        """Pad parallel index/value arrays to a pow2 length by repeating
+        entry 0 (a duplicate scatter of the SAME value is deterministic),
+        so varying delta sizes reuse a handful of compiled programs."""
+        n = len(arrays[0])
+        npad = 1 << (n - 1).bit_length()
+        if npad == n:
+            return arrays
+        return [np.concatenate([a, np.repeat(a[:1], npad - n)]) for a in arrays]
+
+    def _leaf_delta(self, key, row: int, stale, frags, fingerprint):
+        """Refresh a stale cached (S, W) leaf; None = caller must
+        full-regather."""
+        old_fp, arr = stale
+        if self._delta_max_fraction <= 0 or len(old_fp) != len(fingerprint):
+            return None
+        updates = self._collect_updates(
+            (((i,), frag, row, old_fp[i], fingerprint[i])
+             for i, frag in enumerate(frags)
+             if old_fp[i] != fingerprint[i]),
+            arr.size,
+        )
+        if updates is None:
+            return None
+        if not updates:
+            # Nothing in THIS row changed: republish the same device array
+            # under the fresh fingerprint (zero bytes moved).
+            new_arr, moved = arr, 0
+        else:
+            rows, cols, vals = self._pad_updates([
+                np.concatenate([np.full(len(c), co[0], np.int32)
+                                for co, c, _ in updates]),
+                np.concatenate([c for _, c, _ in updates]),
+                np.concatenate([v for _, _, v in updates]),
+            ])
+            sig = ("leaf_delta", arr.shape, len(rows))
+            fn = self._fn_build(self._count_fns, sig, lambda: jax.jit(
+                lambda a, r, c, v: a.at[r, c].set(v),
+                out_shardings=shard_sharding(self.mesh, 2),
+            ))
+            new_arr = fn(arr, rows, cols, vals)
+            moved = int(rows.nbytes + cols.nbytes + vals.nbytes)
+        with self._lock:
+            self.counters["leaf_delta_hits"] += 1
+            self.counters["delta_bytes"] += moved
+            self._leaf_bytes = self._byte_cache_put(
+                self._leaf_cache, key, (fingerprint, new_arr),
+                self._leaf_budget, self._leaf_bytes, "leaf_evictions",
+            )
+        return new_arr
+
+    def _stack_delta(self, key, index: str, leaves, shards, stale, fp):
+        """Refresh a stale (U, S, W) stack with one scattered update — no
+        host walk, no member re-gather, no restack. None = full rebuild."""
+        old_fp, arr = stale
+        if self._delta_max_fraction <= 0 or len(old_fp) != len(fp):
+            return None
+        if any(len(o) != len(n) for o, n in zip(old_fp, fp)):
+            return None
+
+        def members():
+            for u, leaf in enumerate(leaves):
+                if old_fp[u] == fp[u]:
+                    continue
+                for i, s in enumerate(shards):
+                    if old_fp[u][i] == fp[u][i]:
+                        continue
+                    frag = self.holder.fragment(index, leaf.field, leaf.view, s)
+                    yield (u, i), frag, leaf.row, old_fp[u][i], fp[u][i]
+
+        updates = self._collect_updates(members(), arr.size)
+        if updates is None:
+            return None
+        # pow2 padding rows duplicate leaf 0; today no compiled program
+        # reads them, but the full-rebuild invariant is pad == leaf 0's
+        # CURRENT plane, so replicate leaf-0 updates onto every pad row
+        # rather than trusting a comment to keep them unread forever.
+        leaf0 = [(co, c, v) for co, c, v in updates if co[0] == 0]
+        for pad_u in range(len(leaves), arr.shape[0]):
+            updates.extend(((pad_u, co[1]), c, v) for co, c, v in leaf0)
+        if not updates:
+            new_arr, moved = arr, 0
+        else:
+            us, rows, cols, vals = self._pad_updates([
+                np.concatenate([np.full(len(c), co[0], np.int32)
+                                for co, c, _ in updates]),
+                np.concatenate([np.full(len(c), co[1], np.int32)
+                                for co, c, _ in updates]),
+                np.concatenate([c for _, c, _ in updates]),
+                np.concatenate([v for _, _, v in updates]),
+            ])
+            sig = ("stack_delta", arr.shape, len(us))
+            fn = self._fn_build(self._count_fns, sig, lambda: jax.jit(
+                lambda a, u, r, c, v: a.at[u, r, c].set(v),
+                out_shardings=shard_sharding(self.mesh, 3, axis=1),
+            ))
+            new_arr = fn(arr, us, rows, cols, vals)
+            moved = int(us.nbytes + rows.nbytes + cols.nbytes + vals.nbytes)
+        with self._lock:
+            self.counters["stack_delta_hits"] += 1
+            self.counters["delta_bytes"] += moved
+            self._stack_bytes = self._byte_cache_put(
+                self._stack_cache, key, (fp, new_arr),
+                self._stack_budget, self._stack_bytes, "stack_evictions",
+            )
+        return new_arr
 
     def _leaf_tensor(self, index: str, leaves: List[Leaf], shards: Tuple[int, ...]):
         """Tuple of per-leaf (S, W) sharded arrays. Passed as a pytree into
@@ -486,6 +744,14 @@ class ShardedQueryEngine:
         if stacked is not None:
             return stacked
         try:
+            # Stale resident stack: one scattered update beats regathering
+            # every member and restacking the whole (U, S, W) tensor.
+            with self._lock:
+                stale = self._stack_cache.get(key)
+            if stale is not None:
+                stacked = self._stack_delta(key, index, leaves, shards, stale, fp)
+                if stacked is not None:
+                    return stacked
             # Stale or missing: gather member planes (leaf-cache hits are
             # cheap; on a fresh stack hit above no gather happens at all).
             arrs = [self._gather_leaf(index, leaf, shards) for leaf in leaves]
@@ -510,6 +776,17 @@ class ShardedQueryEngine:
 
     # ----------------------------------------------------------- query memo
 
+    def _epoch_token(self, index: str):
+        """(incarnation, value) of the index's write epoch, or -1 when the
+        index doesn't exist. A bare value would let a recreated index whose
+        fresh epoch climbs back to a stored entry's number alias the OLD
+        index's memoized count; the incarnation pair can't collide."""
+        idx = self.holder.index(index)
+        if idx is None:
+            return -1
+        ep = idx.write_epoch
+        return (ep.incarnation, ep.value)
+
     def memo_probe(self, index: str, comp: "_Compiler",
                    shards: Tuple[int, ...]):
         """(memoized count or None, store token) for an already-compiled
@@ -523,22 +800,40 @@ class ShardedQueryEngine:
         time fingerprint the entry just misses on the next probe (the safe
         direction, matching the leaf cache's fp-before-read ordering)."""
         key = (index, tuple(comp.signature), tuple(comp.leaves), shards)
+        # O(1) staleness fast path: when the index's write epoch hasn't
+        # moved since the entry was stored, NOTHING in the index changed,
+        # so the O(U x S) per-fragment fingerprint walk below is pure
+        # overhead — on a quiet index a hot repeat query probes in one
+        # attribute read + dict lookup. Epoch is read BEFORE the walk /
+        # execution (probe-time discipline, see below), so a concurrent
+        # write can only make the stored epoch conservatively old.
+        epoch = self._epoch_token(index)
+        with self._lock:
+            ent = self._memo.get(key)
+            if ent is not None and epoch != -1 and ent[1] == epoch:
+                self._memo[key] = self._memo.pop(key)  # LRU touch
+                self.counters["memo_hits"] += 1
+                return ent[2], (key, ent[0], epoch)
         fp = tuple(self._fingerprint(index, leaf, shards) for leaf in comp.leaves)
-        token = (key, fp)
+        token = (key, fp, epoch)
         with self._lock:
             ent = self._memo.get(key)
             if ent is not None and ent[0] == fp:
-                self._memo[key] = self._memo.pop(key)  # LRU touch
+                # Epoch moved (a write elsewhere in the index) but these
+                # leaves didn't: refresh the stored epoch so the next
+                # probe is O(1) again.
+                self._memo.pop(key)
+                self._memo[key] = (fp, epoch, ent[2])
                 self.counters["memo_hits"] += 1
-                return ent[1], token
+                return ent[2], token
             self.counters["memo_misses"] += 1
         return None, token
 
     def memo_store(self, token, count: int) -> None:
-        key, fp = token
+        key, fp, epoch = token
         with self._lock:
             self._memo.pop(key, None)
-            self._memo[key] = (fp, count)
+            self._memo[key] = (fp, epoch, count)
             while len(self._memo) > self._memo_budget:
                 self._memo.pop(next(iter(self._memo)))
 

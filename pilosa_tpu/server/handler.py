@@ -558,6 +558,15 @@ class Handler:
         if engine is not None:
             out = dict(out)
             out["engine_cache"] = dict(engine.counters)
+            # Delta-refresh health pulled out as its own group: the on-call
+            # question under mixed read/write traffic is "are writes
+            # costing scattered KiB updates or full plane re-uploads", and
+            # that should not require knowing the counter-dict layout.
+            out["delta_refresh"] = {
+                k: engine.counters.get(k, 0)
+                for k in ("leaf_delta_hits", "stack_delta_hits",
+                          "delta_bytes", "full_refresh_bytes")
+            }
         # Scheduler lifecycle metrics: queue depth, admit/shed/deadline
         # counts, and the micro-batcher's launch/coalesce counters (wait
         # time and batch-size histograms live in the stats timings above).

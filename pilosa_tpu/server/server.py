@@ -59,6 +59,7 @@ class Server:
         internal_key_path: Optional[str] = None,
         scheduler_config=None,
         storage_config=None,
+        engine_config=None,
         join_addr: Optional[str] = None,
         allowed_origins: Optional[List[str]] = None,
         tls_certificate: Optional[str] = None,
@@ -109,6 +110,8 @@ class Server:
             stats=self.stats,
             broadcast_shard=self._on_new_shard,
             storage_config=storage_config,
+            delta_journal_ops=(
+                engine_config.delta_journal_ops if engine_config else None),
         )
         self.translate_store = TranslateStore(
             os.path.join(data_dir, "keys") if data_dir else None,
@@ -142,6 +145,7 @@ class Server:
             translate_store=self.translate_store,
             max_writes_per_request=max_writes_per_request,
             workers=executor_workers,
+            engine_config=engine_config,
         )
         # Query scheduler (sched/): admission control + deadlines +
         # cross-query micro-batching, the gate between the HTTP handler

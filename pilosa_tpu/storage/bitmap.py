@@ -809,6 +809,22 @@ class Bitmap:
             return np.empty(0, dtype=np.uint64)
         return np.concatenate(parts)
 
+    def words64(self, idxs: np.ndarray) -> np.ndarray:
+        """Values of the given global 64-bit word indices (word i covers
+        bits [64i, 64i+64)). O(touched containers): the point-read analog
+        of range_words, used by delta refreshes to fetch only the words a
+        write changed. Missing containers read as zero."""
+        idxs = np.asarray(idxs, dtype=np.int64)
+        out = np.zeros(len(idxs), dtype=np.uint64)
+        keys = idxs >> 10  # BITMAP_N (1024) words per container
+        for key in np.unique(keys):
+            c = self.containers.get(int(key))
+            if c is None:
+                continue
+            m = keys == key
+            out[m] = _as_container(c).as_words()[idxs[m] & 1023]
+        return out
+
     def range_words(self, start: int, end: int) -> np.ndarray:
         """Bits [start, end) as a dense little-endian uint64 word array
         ((end-start)//64 words). start/end must be container-aligned. Dense

@@ -1,0 +1,63 @@
+"""Tier-1 guard for bench.py: BENCH_SMOKE=1 must run EVERY stanza at micro
+scale and emit a complete, parseable JSON line.
+
+Two measurement rounds were lost to rc=124 / `parsed: null` because bench
+breakage only surfaced at measurement time; this test makes a broken
+stanza (or a hung bring-up path) a PR-time failure instead.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+STANZAS = (
+    "hbm", "big", "scale", "open", "import", "serving", "sched", "mixed",
+    "topn_bsi", "time_range",
+)
+
+
+def test_bench_smoke_runs_every_stanza(tmp_path):
+    out_path = tmp_path / "bench_out.json"
+    env = dict(os.environ)
+    env.update(
+        BENCH_SMOKE="1",
+        BENCH_OUT=str(out_path),
+        # One CPU device: smoke validates bench CODE; the 8-device test
+        # mesh only slows the subprocess's compiles down.
+        XLA_FLAGS="",
+        JAX_PLATFORMS="cpu",
+        # Belt and braces: if a stanza still wedges, the bench's own
+        # watchdog emits a partial line well inside the pytest timeout.
+        BENCH_DEADLINE="240",
+    )
+    r = subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, f"bench rc={r.returncode}\n{r.stderr[-2000:]}"
+
+    # The driver parses the LAST JSON line of stdout; hold bench to that.
+    last = None
+    for line in r.stdout.strip().splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            last = line
+    assert last is not None, f"no JSON line in stdout:\n{r.stdout[-2000:]}"
+    parsed = json.loads(last)
+    detail = parsed["detail"]
+    assert not detail.get("partial"), detail.get("partial")
+    assert parsed["value"] > 0
+    for name in STANZAS:
+        stanza = detail.get(name)
+        assert isinstance(stanza, dict), f"stanza {name} missing: {stanza}"
+        assert "error" not in stanza, f"stanza {name}: {stanza['error']}"
+    # The MIXED stanza is the delta-refresh acceptance metric: delta-on
+    # must move fewer bytes to the device than delta-off.
+    mixed = detail["mixed"]
+    assert mixed["delta_ok"], mixed
+
+    # BENCH_OUT got the same line atomically.
+    assert json.loads(out_path.read_text())["detail"]["mixed"]["delta_ok"]
