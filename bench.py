@@ -971,6 +971,161 @@ def bench_mixed():
     return out
 
 
+# --------------------------------------------- peer fault / brown-out stanza
+
+
+def bench_fault():
+    """Scripted peer brown-out through the resilience layer (docs/
+    fault-tolerance.md): a 3-node replica_n=2 cluster serves Count
+    queries from node0 while one peer's link degrades in phases —
+    healthy -> flaky(0.5) (brown-out) -> drop (blackhole) -> healed.
+    Reports per-phase qps and p50/p99 latency, the recovery time from
+    fault-clear to converged routing (every breaker re-closed, a full
+    clean query round), and node0's breaker/retry/hedge counters as
+    evidence that a blackholed peer stops costing connect attempts and
+    replica retries stayed inside the budget."""
+    import shutil
+    import socket
+    import tempfile
+
+    from pilosa_tpu import failpoints
+    from pilosa_tpu.cluster.hash import ModHasher
+    from pilosa_tpu.cluster.health import CLOSED, ResilienceConfig
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.errors import PilosaError
+    from pilosa_tpu.server.client import ClientError, InternalClient
+    from pilosa_tpu.server.server import Server
+
+    n_rows, per_phase = (2, 6) if SMOKE else (4, 50)
+    n_shards = 2 if SMOKE else 4
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmp = tempfile.mkdtemp(prefix="bench-fault-")
+    ports = [free_port() for _ in range(3)]
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = []
+    out = {"shards": n_shards, "rows": n_rows, "queries_per_phase": per_phase}
+    try:
+        for i, port in enumerate(ports):
+            s = Server(
+                data_dir=os.path.join(tmp, f"node{i}"),
+                port=port,
+                cluster_hosts=hosts,
+                replica_n=2,
+                hasher=ModHasher(),
+                cache_flush_interval=0,
+                anti_entropy_interval=0,
+                member_monitor_interval=0,  # convergence driven below
+                resilience_config=ResilienceConfig(
+                    breaker_backoff=0.1, breaker_backoff_max=0.5,
+                ),
+            )
+            s.open()
+            servers.append(s)
+        client = InternalClient(timeout=10.0)
+        client.create_index(hosts[0], "ft")
+        client.create_field(hosts[0], "ft", "f")
+        time.sleep(0.05)
+        for row in range(n_rows):
+            for shard in range(n_shards):
+                client.query(
+                    hosts[0], "ft",
+                    f"Set({shard * SHARD_WIDTH + row + 1}, f={row})",
+                )
+        # Query head: a node that does NOT own some shard, so full-index
+        # queries must fan out remotely; fault target: that shard's
+        # preferred owner. (Each shard excludes exactly one of the three
+        # nodes, so such a pair always exists.)
+        s0 = target = None
+        for s in servers:
+            for shard in range(n_shards):
+                owners = s.cluster.shard_nodes("ft", shard)
+                if all(n.id != s.node.id for n in owners):
+                    s0, target = s, owners[0].uri
+                    break
+            if s0 is not None:
+                break
+        assert s0 is not None, "placement gave every node every shard"
+        h0 = s0.node.uri
+
+        def run_phase(n):
+            lat = []
+            ok = err = 0
+            t0 = time.perf_counter()
+            for i in range(n):
+                q0 = time.perf_counter()
+                try:
+                    client.query(h0, "ft", f"Count(Row(f={i % n_rows}))")
+                    ok += 1
+                    lat.append(time.perf_counter() - q0)
+                except (ClientError, PilosaError):
+                    err += 1
+            dt = time.perf_counter() - t0
+            lat.sort()
+            pick = (lambda q: round(
+                lat[min(len(lat) - 1, int(len(lat) * q))] * 1e3, 2
+            )) if lat else (lambda q: None)
+            return {"qps": round(ok / dt, 1) if dt else 0.0,
+                    "p50_ms": pick(0.50), "p99_ms": pick(0.99),
+                    "ok": ok, "errors": err}
+
+        out["healthy"] = run_phase(per_phase)
+        failpoints.seed(7)
+        failpoints.configure(f"client-send@{target}", "flaky", arg=0.5)
+        out["brownout_flaky"] = run_phase(per_phase)
+        failpoints.configure(f"client-send@{target}", "drop")
+        out["blackhole"] = run_phase(per_phase)
+        failpoints.reset()
+
+        # Recovery: from fault-clear to converged routing — breakers
+        # re-closed everywhere and one fully clean, correct query round.
+        t0 = time.perf_counter()
+        deadline = t0 + 30.0
+        recovered = False
+        while time.perf_counter() < deadline and not recovered:
+            for s in servers:
+                s._monitor_members()
+            try:
+                for row in range(n_rows):
+                    got = client.query(h0, "ft", f"Count(Row(f={row}))")
+                    assert got["results"][0] == n_shards
+            except (ClientError, PilosaError, AssertionError):
+                time.sleep(0.02)
+                continue
+            snap = s0.cluster.health.snapshot()
+            recovered = all(
+                p["state"] == CLOSED for p in snap["peers"].values()
+            )
+        out["recovery_s"] = round(time.perf_counter() - t0, 3)
+        out["recovered"] = recovered
+        snap = s0.cluster.health.snapshot()
+        out["breaker"] = {k: snap[k] for k in (
+            "breaker_opened", "breaker_closed", "breaker_short_circuits",
+            "half_open_probes", "retries_spent", "retries_denied",
+            "hedges_fired", "hedges_won",
+        )}
+        out["fault_ok"] = bool(
+            recovered
+            and out["healthy"]["errors"] == 0
+            and snap["breaker_opened"] >= 1
+        )
+    finally:
+        failpoints.reset()
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # ------------------------------------------------------- import stanza
 
 
@@ -1575,6 +1730,7 @@ def main():
     serving = stanza("SERVING", bench_serving)
     sched = stanza("SCHED", bench_sched)
     mixed = stanza("MIXED", bench_mixed)
+    fault = stanza("FAULT", bench_fault)
     topn_bsi = stanza("TOPN_BSI", bench_topn_bsi)
     time_range = stanza("TIME_RANGE", bench_time_range)
 
@@ -1691,6 +1847,7 @@ def main():
             # the driver parses the LAST line, so they must ride it too.
             "sched": sched,
             "mixed": mixed,
+            "fault": fault,
             "topn_bsi": topn_bsi,
             "time_range": time_range,
             **extra,

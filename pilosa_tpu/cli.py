@@ -45,8 +45,44 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--gossip-probe-interval", dest="gossip_probe_interval", type=float)
     p.add_argument("--gossip-failover-probes", dest="gossip_failover_probes", type=int)
     p.add_argument("--gossip-probe-timeout", dest="gossip_probe_timeout", type=float)
+    p.add_argument("--gossip-probe-failures", dest="gossip_probe_failures",
+                   type=int,
+                   help="consecutive failed heartbeat probes before a peer "
+                        "is marked unavailable (flap damping)")
     p.add_argument("--gossip-key", dest="gossip_key",
                    help="path to cluster shared-secret file")
+    p.add_argument("--resilience-breaker-failures",
+                   dest="resilience_breaker_failures", type=int,
+                   help="consecutive transport failures before a peer's "
+                        "circuit breaker opens")
+    p.add_argument("--resilience-breaker-backoff",
+                   dest="resilience_breaker_backoff", type=float,
+                   help="initial open->half-open breaker backoff in seconds "
+                        "(doubles per failed probe)")
+    p.add_argument("--resilience-breaker-backoff-max",
+                   dest="resilience_breaker_backoff_max", type=float)
+    p.add_argument("--resilience-probe-ttl", dest="resilience_probe_ttl",
+                   type=float,
+                   help="seconds before an unreported half-open probe "
+                        "counts as failed")
+    p.add_argument("--resilience-retry-budget",
+                   dest="resilience_retry_budget", type=float,
+                   help="retry token bucket capacity gating replica "
+                        "re-maps (0 = unlimited)")
+    p.add_argument("--resilience-retry-refill",
+                   dest="resilience_retry_refill", type=float,
+                   help="retry tokens refilled per successful remote "
+                        "request")
+    p.add_argument("--resilience-hedge-delay",
+                   dest="resilience_hedge_delay", type=float,
+                   help="fixed hedge delay in seconds (0 = adaptive "
+                        "per-peer p99)")
+    p.add_argument("--resilience-hedge-max-fraction",
+                   dest="resilience_hedge_max_fraction", type=float,
+                   help="cap on hedged reads as a fraction of remote "
+                        "requests (0 disables hedging)")
+    p.add_argument("--resilience-hedge-min-delay",
+                   dest="resilience_hedge_min_delay", type=float)
     p.add_argument("--sched-max-queue", dest="sched_max_queue", type=int,
                    help="bounded admission queue; full requests get 429")
     p.add_argument("--sched-interactive-concurrency",

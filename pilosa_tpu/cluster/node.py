@@ -13,6 +13,7 @@ from typing import List, Optional
 
 from ..constants import DEFAULT_PARTITION_N
 from .hash import JmpHasher, partition as partition_of
+from .health import DownView, HealthRegistry
 
 # Cluster states (reference cluster.go:43-45).
 STATE_STARTING = "STARTING"
@@ -65,10 +66,16 @@ class Cluster:
         self.partition_n = partition_n
         self.hasher = hasher or JmpHasher()
         self.state = STATE_NORMAL
-        # Node ids currently failing health probes (failure detector; the
-        # reference's memberlist suspicion state). Placement ignores this;
-        # the executor's owner selection and retry logic consult it.
-        self.unavailable: set = set()
+        # Per-peer fault-tolerance state (cluster/health.py): circuit
+        # breakers, retry budget, rolling latencies. The server installs
+        # its [resilience] config via health.configure(); library users
+        # get the defaults. Placement ignores this; the executor's owner
+        # selection, retry, and hedging logic consult it.
+        self.health = HealthRegistry()
+        # Node ids currently down (failure detector; the reference's
+        # memberlist suspicion state). A set-like view over the breaker
+        # state: `in` means "breaker not closed", add/discard force it.
+        self.unavailable = DownView(self.health)
 
     # ------------------------------------------------------------ placement
 
@@ -86,14 +93,6 @@ class Cluster:
 
     def shard_nodes(self, index: str, shard: int) -> List[Node]:
         return self.partition_nodes(self.partition(index, shard))
-
-    def available_shard_nodes(self, index: str, shard: int, exclude=()) -> List[Node]:
-        """Owners that are believed alive and not in `exclude`."""
-        return [
-            n
-            for n in self.shard_nodes(index, shard)
-            if n.id not in self.unavailable and n.id not in exclude
-        ]
 
     def mark_unavailable(self, node_id: str) -> None:
         self.unavailable.add(node_id)
@@ -141,4 +140,8 @@ class Cluster:
         if n is None:
             return False
         self.nodes.remove(n)
+        # Drop health/availability state with the membership entry: a
+        # removed node's stale breaker must not shadow a later re-add
+        # that reuses the same id.
+        self.health.prune(node_id)
         return True

@@ -29,7 +29,12 @@ class HolderSyncer:
         me = self.cluster.node.id
         if not any(n.id == me for n in nodes):
             return None  # not owned here
-        return [n for n in nodes if n.id != me]
+        # Shared peer-health state (cluster/health.py): a replica whose
+        # breaker is open gets skipped for this sweep instead of costing
+        # one connect timeout per fragment; the next sweep retries after
+        # the breaker readmits it.
+        health = self.cluster.health
+        return [n for n in nodes if n.id != me and not health.is_down(n.id)]
 
     def sync_holder(self) -> None:
         for index_name in self.holder.index_names():
@@ -61,7 +66,11 @@ class HolderSyncer:
     # ---------------------------------------------------------------- attrs
 
     def _sync_attrs(self, index: str, field, store) -> None:
-        replicas = [n for n in self.cluster.nodes if n.id != self.cluster.node.id]
+        health = self.cluster.health
+        replicas = [
+            n for n in self.cluster.nodes
+            if n.id != self.cluster.node.id and not health.is_down(n.id)
+        ]
         if not replicas:
             return
         blocks = [{"id": bid, "checksum": chk.hex()} for bid, chk in store.blocks()]

@@ -49,6 +49,11 @@ class GossipConfig:
 
     probe_interval: float = 2.0  # seconds between member heartbeat rounds
     probe_timeout: float = 2.0  # per-probe HTTP deadline (seconds)
+    # Flap damping: consecutive failed heartbeat probes before the member
+    # monitor marks a peer unavailable (1 = mark on the first failure,
+    # the pre-damping behavior). The data path's own circuit breaker
+    # ([resilience] breaker-failures) is independent of this.
+    probe_failures: int = 3
     # Consecutive failed coordinator heartbeats before the deterministic
     # successor (lowest alive node id, majority required) self-promotes;
     # 0 disables automatic failover (reference behavior: manual
@@ -70,6 +75,11 @@ from .storage import StorageConfig  # noqa: E402
 # engine (pilosa_tpu/parallel/__init__.py, jax-free so CLI startup stays
 # light). See docs/engine-caches.md.
 from .parallel import EngineConfig  # noqa: E402
+
+# And for [resilience]: the peer fault-tolerance knobs (circuit breakers,
+# retry budget, hedged reads) live with the health registry they govern
+# (cluster/health.py, stdlib-only). See docs/fault-tolerance.md.
+from .cluster.health import ResilienceConfig  # noqa: E402
 
 
 @dataclass
@@ -111,6 +121,7 @@ class Config:
     scheduler: SchedConfig = field(default_factory=SchedConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     translation: TranslationConfig = field(default_factory=TranslationConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
@@ -147,8 +158,27 @@ class Config:
         g = d.get("gossip", {})
         self.gossip.probe_interval = g.get("probe-interval", self.gossip.probe_interval)
         self.gossip.probe_timeout = g.get("probe-timeout", self.gossip.probe_timeout)
+        self.gossip.probe_failures = g.get("probe-failures", self.gossip.probe_failures)
         self.gossip.failover_probes = g.get("failover-probes", self.gossip.failover_probes)
         self.gossip.key = g.get("key", self.gossip.key)
+        r = d.get("resilience", {})
+        self.resilience.breaker_failures = r.get(
+            "breaker-failures", self.resilience.breaker_failures)
+        self.resilience.breaker_backoff = r.get(
+            "breaker-backoff", self.resilience.breaker_backoff)
+        self.resilience.breaker_backoff_max = r.get(
+            "breaker-backoff-max", self.resilience.breaker_backoff_max)
+        self.resilience.probe_ttl = r.get("probe-ttl", self.resilience.probe_ttl)
+        self.resilience.retry_budget = r.get(
+            "retry-budget", self.resilience.retry_budget)
+        self.resilience.retry_refill = r.get(
+            "retry-refill", self.resilience.retry_refill)
+        self.resilience.hedge_delay = r.get(
+            "hedge-delay", self.resilience.hedge_delay)
+        self.resilience.hedge_max_fraction = r.get(
+            "hedge-max-fraction", self.resilience.hedge_max_fraction)
+        self.resilience.hedge_min_delay = r.get(
+            "hedge-min-delay", self.resilience.hedge_min_delay)
         s = d.get("scheduler", {})
         self.scheduler.max_queue = s.get("max-queue", self.scheduler.max_queue)
         self.scheduler.interactive_concurrency = s.get(
@@ -223,12 +253,27 @@ class Config:
         for attr, name, cast in [
             ("probe_interval", "GOSSIP_PROBE_INTERVAL", float),
             ("probe_timeout", "GOSSIP_PROBE_TIMEOUT", float),
+            ("probe_failures", "GOSSIP_PROBE_FAILURES", int),
             ("failover_probes", "GOSSIP_FAILOVER_PROBES", int),
             ("key", "GOSSIP_KEY", str),
         ]:
             v = env(name, cast)
             if v is not None:
                 setattr(self.gossip, attr, v)
+        for attr, name, cast in [
+            ("breaker_failures", "RESILIENCE_BREAKER_FAILURES", int),
+            ("breaker_backoff", "RESILIENCE_BREAKER_BACKOFF", float),
+            ("breaker_backoff_max", "RESILIENCE_BREAKER_BACKOFF_MAX", float),
+            ("probe_ttl", "RESILIENCE_PROBE_TTL", float),
+            ("retry_budget", "RESILIENCE_RETRY_BUDGET", float),
+            ("retry_refill", "RESILIENCE_RETRY_REFILL", float),
+            ("hedge_delay", "RESILIENCE_HEDGE_DELAY", float),
+            ("hedge_max_fraction", "RESILIENCE_HEDGE_MAX_FRACTION", float),
+            ("hedge_min_delay", "RESILIENCE_HEDGE_MIN_DELAY", float),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.resilience, attr, v)
         for attr, name, cast in [
             ("max_queue", "SCHED_MAX_QUEUE", int),
             ("interactive_concurrency", "SCHED_INTERACTIVE_CONCURRENCY", int),
@@ -286,8 +331,20 @@ class Config:
             "anti_entropy_interval": ("anti_entropy", "interval"),
             "gossip_probe_interval": ("gossip", "probe_interval"),
             "gossip_probe_timeout": ("gossip", "probe_timeout"),
+            "gossip_probe_failures": ("gossip", "probe_failures"),
             "gossip_failover_probes": ("gossip", "failover_probes"),
             "gossip_key": ("gossip", "key"),
+            "resilience_breaker_failures": ("resilience", "breaker_failures"),
+            "resilience_breaker_backoff": ("resilience", "breaker_backoff"),
+            "resilience_breaker_backoff_max":
+                ("resilience", "breaker_backoff_max"),
+            "resilience_probe_ttl": ("resilience", "probe_ttl"),
+            "resilience_retry_budget": ("resilience", "retry_budget"),
+            "resilience_retry_refill": ("resilience", "retry_refill"),
+            "resilience_hedge_delay": ("resilience", "hedge_delay"),
+            "resilience_hedge_max_fraction":
+                ("resilience", "hedge_max_fraction"),
+            "resilience_hedge_min_delay": ("resilience", "hedge_min_delay"),
             "sched_max_queue": ("scheduler", "max_queue"),
             "sched_interactive_concurrency": ("scheduler", "interactive_concurrency"),
             "sched_batch_concurrency": ("scheduler", "batch_concurrency"),
@@ -347,8 +404,20 @@ class Config:
             "[gossip]",
             f"probe-interval = {self.gossip.probe_interval}",
             f"probe-timeout = {self.gossip.probe_timeout}",
+            f"probe-failures = {self.gossip.probe_failures}",
             f"failover-probes = {self.gossip.failover_probes}",
             f"key = {fmt(self.gossip.key)}",
+            "",
+            "[resilience]",
+            f"breaker-failures = {self.resilience.breaker_failures}",
+            f"breaker-backoff = {self.resilience.breaker_backoff}",
+            f"breaker-backoff-max = {self.resilience.breaker_backoff_max}",
+            f"probe-ttl = {self.resilience.probe_ttl}",
+            f"retry-budget = {self.resilience.retry_budget}",
+            f"retry-refill = {self.resilience.retry_refill}",
+            f"hedge-delay = {self.resilience.hedge_delay}",
+            f"hedge-max-fraction = {self.resilience.hedge_max_fraction}",
+            f"hedge-min-delay = {self.resilience.hedge_min_delay}",
             "",
             "[scheduler]",
             f"max-queue = {self.scheduler.max_queue}",
@@ -418,11 +487,13 @@ class Config:
             max_writes_per_request=self.max_writes_per_request,
             member_monitor_interval=self.gossip.probe_interval,
             member_probe_timeout=self.gossip.probe_timeout,
+            member_probe_failures=self.gossip.probe_failures,
             coordinator_failover_probes=self.gossip.failover_probes,
             internal_key_path=self.gossip.key or None,
             scheduler_config=self.scheduler,
             storage_config=self.storage.validate(),
             engine_config=self.engine,
+            resilience_config=self.resilience.validate(),
         )
         kw.update(overrides)
         return Server(**kw)

@@ -98,6 +98,23 @@ class DiagnosticsCollector:
             info["engineStackDeltaHits"] = c.get("stack_delta_hits", 0)
             info["engineDeltaBytes"] = c.get("delta_bytes", 0)
             info["engineFullRefreshBytes"] = c.get("full_refresh_bytes", 0)
+        # Peer fault-tolerance shape: how often breakers tripped, whether
+        # replica retries ran into the budget, and how much traffic was
+        # hedged — the aggregate story of how rough this node's network
+        # neighborhood is (per-peer detail stays in /debug/vars).
+        health = getattr(self.server.cluster, "health", None)
+        if health is not None:
+            snap = health.snapshot()
+            info["resilienceBreakerOpened"] = snap.get("breaker_opened", 0)
+            info["resilienceShortCircuits"] = snap.get(
+                "breaker_short_circuits", 0)
+            info["resilienceRetriesDenied"] = snap.get("retries_denied", 0)
+            info["resilienceHedgesFired"] = snap.get("hedges_fired", 0)
+            info["resilienceHedgesWon"] = snap.get("hedges_won", 0)
+            info["resilienceOpenPeers"] = sum(
+                1 for p in snap.get("peers", {}).values()
+                if p.get("state") != "closed"
+            )
         info.update(system_info())
         info.update(self._extra)
         return info

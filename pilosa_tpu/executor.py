@@ -156,11 +156,22 @@ class Executor:
         return self._engine
 
     def close(self) -> None:
-        """Release serving resources (thread pools)."""
+        """Release serving resources (thread pools, client sockets)."""
         if self._pool is not None:
             self._pool.shutdown(wait=False)
         if self._engine is not None:
             self._engine.close()
+        # The internal client's per-thread keep-alive pools are registered
+        # for exactly this moment: embedded/library users own client
+        # lifetime through the executor (close() is idempotent, so the
+        # server closing the same shared client again is harmless).
+        if self.client is not None and hasattr(self.client, "close"):
+            self.client.close()
+
+    @property
+    def health(self):
+        """Per-peer breaker/budget/latency state (cluster/health.py)."""
+        return self.cluster.health
 
 
     @property
@@ -261,18 +272,41 @@ class Executor:
     # ----------------------------------------------------------- mapReduce
 
     def _assign_shards(self, index: str, shards: List[int], exclude=()):
-        """Shards -> (local list, {node_id: shards}) using availability info.
+        """Shards -> (local list, {node_id: shards}) using health info.
 
         Prefers self when a replica (maximizes local device work,
-        executor.go:1444-1458); skips nodes in `exclude`/marked unavailable.
-        """
+        executor.go:1444-1458); skips nodes in `exclude` and peers whose
+        circuit breaker refuses traffic. The breaker gate is consulted
+        lazily in placement order and memoized per assignment round, so a
+        down peer whose backoff elapsed is admitted for its WHOLE shard
+        batch — that one batched request is the half-open probe, and its
+        outcome (recorded by the fan-out) decides re-close vs re-open."""
+        health = self.cluster.health
+        admitted: Dict[str, bool] = {}
+
+        def ok(node_id: str) -> bool:
+            if node_id not in admitted:
+                admitted[node_id] = health.allow_request(node_id)
+            return admitted[node_id]
+
         local: List[int] = []
         remote: Dict[str, List[int]] = {}
         for shard in shards:
-            nodes = self.cluster.available_shard_nodes(index, shard, exclude)
-            if not nodes:
+            nodes = self.cluster.shard_nodes(index, shard)
+            owner = None
+            if any(n.id == self.node.id for n in nodes) and (
+                self.node.id not in exclude
+            ):
+                owner = self.node
+            else:
+                for n in nodes:  # placement order, like the reference
+                    if n.id in exclude:
+                        continue
+                    if ok(n.id):
+                        owner = n
+                        break
+            if owner is None:
                 raise PilosaError(f"no available node owns shard {shard}")
-            owner = next((n for n in nodes if n.id == self.node.id), nodes[0])
             if owner.id == self.node.id:
                 local.append(shard)
             else:
@@ -349,10 +383,7 @@ class Executor:
                     opt.deadline.check("remote fan-out")
                     kw["deadline"] = opt.deadline.remaining()
                 try:
-                    v = self.client.query_node(
-                        node, index, str(c), shards=node_shards, remote=True,
-                        **kw,
-                    )[0]
+                    v = self._remote_dispatch(node, index, c, node_shards, kw)
                 except ClientError as e:
                     if opt.deadline is not None and opt.deadline.expired():
                         # The peer failed while OUR budget ran out (its
@@ -363,22 +394,123 @@ class Executor:
                         opt.deadline.check("remote fan-out")
                     if not _is_node_failure(e):
                         # 4xx: the peer executed and rejected the query.
-                        # The node is healthy — do NOT mark it unavailable —
-                        # but the error may be transient schema lag, so try
-                        # the shards on a replica first and only surface the
-                        # error once owners are exhausted.
+                        # The node is TRANSPORT-healthy, so this counts as
+                        # breaker success (a half-open probe answered with
+                        # an app error must re-close, not wedge HALF_OPEN
+                        # until probe_ttl) — but the error may be transient
+                        # schema lag, so try the shards on a replica first
+                        # and only surface it once owners are exhausted.
+                        self.health.record_success(node_id)
                         app_error = app_error or e
                         failed.add(node_id)
+                        if not self.health.try_spend_retry():
+                            # Budget drained: surface the rejection now
+                            # instead of adding replica load.
+                            raise app_error
                         pending.extend(node_shards)
                         continue
-                    # Mark failed, re-map its shards onto replicas
-                    # (executor.go:1498-1508 mapper retry).
+                    # The breaker already advanced inside _remote_dispatch
+                    # (opens after breaker_failures consecutive transport
+                    # failures; default 1 matches executor.go:1498-1508
+                    # mark-dead-on-first-failure). Re-map the shards onto
+                    # replicas — but only within the retry budget, so a
+                    # brown-out cannot amplify load onto the survivors.
                     failed.add(node_id)
-                    self.cluster.mark_unavailable(node_id)
+                    if not self.health.try_spend_retry():
+                        raise PilosaError(
+                            f"retry budget exhausted re-mapping shards of "
+                            f"{node_id}: {e}"
+                        )
                     pending.extend(node_shards)
                     continue
                 result = v if result is None else reduce_fn(result, v)
         return result
+
+    def _remote_dispatch(self, node, index: str, c: Call, node_shards, kw):
+        """One batched query to a peer, with per-peer latency accounting
+        and (when a worker pool exists) a hedged backup request: if the
+        primary hasn't answered within the peer's hedge delay (rolling
+        p99 or the configured fixed delay), the same shard batch is fired
+        at a replica that also owns every shard in it, and the first good
+        response wins. Hedge volume is capped by the health registry."""
+        import time as _time
+
+        health = self.health
+
+        def call(target):
+            """One request with health accounting — success AND transport
+            failure are recorded HERE, whatever thread runs it, so a
+            losing hedge leg (or an abandoned primary) still drives its
+            peer's breaker even when its exception is never re-raised."""
+            t0 = _time.monotonic()
+            try:
+                res = self.client.query_node(
+                    target, index, str(c), shards=node_shards, remote=True,
+                    **kw,
+                )[0]
+            except ClientError as e:
+                if _is_node_failure(e):
+                    health.record_failure(target.id)
+                raise
+            health.record_success(target.id, _time.monotonic() - t0)
+            return res
+
+        from .server.client import ClientError
+
+        if self._pool is None or not health.hedge_enabled():
+            return call(node)
+        hedge_node = self._hedge_replica(index, node, node_shards)
+        if hedge_node is None:
+            return call(node)
+        from concurrent.futures import (
+            FIRST_COMPLETED, TimeoutError as FuturesTimeout, wait,
+        )
+
+        primary = self._pool.submit(call, node)
+        try:
+            # A fast primary failure raises here and takes the normal
+            # replica-retry classification path.
+            return primary.result(timeout=health.hedge_delay(node.id))
+        except FuturesTimeout:
+            pass
+        if not health.allow_hedge():
+            return primary.result()
+        hedge = self._pool.submit(call, hedge_node)
+        futures = {primary, hedge}
+        errors = {}
+        while futures:
+            done, futures = wait(futures, return_when=FIRST_COMPLETED)
+            for fut in done:
+                err = fut.exception()
+                if err is None:
+                    if fut is hedge:
+                        health.note_hedge_won()
+                    return fut.result()
+                errors[fut] = err
+        # Both legs failed: surface the PRIMARY's error so the caller's
+        # retry classification re-maps the shards it actually assigned
+        # (the hedge leg's failure was already recorded by call()).
+        raise errors.get(primary) or errors[hedge]
+
+    def _hedge_replica(self, index: str, primary, node_shards):
+        """A routable peer (breaker closed, not self, not the primary)
+        that owns EVERY shard in the batch, or None. Shard batches group
+        by owner, so replicas usually align; when they don't, hedging is
+        skipped rather than splitting the batch."""
+        health = self.health
+        common = None
+        for shard in node_shards:
+            ids = {n.id for n in self.cluster.shard_nodes(index, shard)}
+            common = ids if common is None else common & ids
+            if not common or common == {primary.id}:
+                return None
+        for nid in sorted(common):
+            if nid in (primary.id, self.node.id) or health.is_down(nid):
+                continue
+            n = self.cluster.node_by_id(nid)
+            if n is not None:
+                return n
+        return None
 
     # ------------------------------------------------------------- bitmaps
 
@@ -973,25 +1105,32 @@ class Executor:
             if remote:
                 applied += 1  # forwarding node already counted the write
                 continue
-            if node.id in self.cluster.unavailable:
-                # Known-dead replica: don't pay a connect timeout per write.
-                errors.append(f"{node.id}: unavailable")
+            if not self.health.allow_request(node.id):
+                # Breaker open: don't pay a connect timeout per write.
+                # (When the backoff has elapsed this forward IS the
+                # half-open probe and goes through.)
+                self.holder.stats.count("WriteForwardSkipped", 1)
+                errors.append(f"{node.id}: unavailable (breaker open)")
                 continue
             try:
                 res = forward_fn(node)
             except ClientError as e:
                 if not _is_node_failure(e):
                     # The replica is alive and rejected the write (4xx):
-                    # surface the divergence — but only after the remaining
-                    # owners got their forward, or one lagging replica would
-                    # cause extra divergence on the others.
+                    # transport-level success for the breaker (a half-open
+                    # probe must re-close, not wedge), but surface the
+                    # divergence — only after the remaining owners got
+                    # their forward, or one lagging replica would cause
+                    # extra divergence on the others.
+                    self.health.record_success(node.id)
                     app_error = app_error or e
                     errors.append(f"{node.id}: {e}")
                     continue
-                self.cluster.mark_unavailable(node.id)
+                self.health.record_failure(node.id)
                 self.holder.stats.count("WriteForwardFailed", 1)
                 errors.append(f"{node.id}: {e}")
                 continue
+            self.health.record_success(node.id)
             applied += 1
             if on_forward_ok is not None:
                 on_forward_ok(res)
@@ -1118,19 +1257,23 @@ class Executor:
         for node in self.cluster.nodes:
             if node.id == self.node.id:
                 continue
-            if node.id in self.cluster.unavailable:
+            if not self.health.allow_request(node.id):
                 self.holder.stats.count("WriteForwardSkipped", 1)
                 continue
             try:
                 self.client.query_node(node, index, str(c), remote=True)
             except ClientError as e:
                 if not _is_node_failure(e):
-                    # Deterministic rejection by a live peer: finish the
-                    # fan-out (don't widen divergence), then surface it.
+                    # Deterministic rejection by a live peer: transport
+                    # success for the breaker; finish the fan-out (don't
+                    # widen divergence), then surface it.
+                    self.health.record_success(node.id)
                     app_error = app_error or e
                     continue
-                self.cluster.mark_unavailable(node.id)
+                self.health.record_failure(node.id)
                 self.holder.stats.count("WriteForwardFailed", 1)
+            else:
+                self.health.record_success(node.id)
         if app_error is not None:
             raise app_error
 

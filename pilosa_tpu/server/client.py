@@ -67,8 +67,12 @@ class InternalClient:
         # Cluster shared secret (gossip.key analog): sent on every request;
         # peers with a key configured refuse unauthenticated /internal/*.
         self.key = key
-        # Per-thread keep-alive connection pool (see _conn).
+        # Per-thread keep-alive connection pool (see _conn). Every
+        # thread's pool dict is also tracked in _pools so close() can
+        # drain sockets owned by threads that no longer exist.
         self._local = threading.local()
+        self._pools_mu = threading.Lock()
+        self._pools: list = []
         # TLS peer-verification opt-out for self-signed cluster certs
         # (reference server/server.go:216-218 InsecureSkipVerify).
         self._ssl_context = None
@@ -100,6 +104,8 @@ class InternalClient:
         pool = getattr(self._local, "conns", None)
         if pool is None:
             pool = self._local.conns = {}
+            with self._pools_mu:
+                self._pools.append(pool)
         entry = pool.get((scheme, netloc))
         if entry is not None:
             conn, last_used = entry
@@ -134,6 +140,24 @@ class InternalClient:
             entry = pool.pop((scheme, netloc), None)
             if entry is not None:
                 entry[0].close()
+
+    def close(self) -> None:
+        """Drain every thread's keep-alive pool. The pools are per-thread
+        but registered centrally at creation, so shutdown can close
+        sockets opened by worker threads that have since exited —
+        previously they leaked until process exit (visible as climbing
+        open-fd counts in tests that churn servers). Idempotent, and a
+        send AFTER close builds (and re-registers) a fresh pool, so the
+        Server and the Executor both closing the shared client is fine."""
+        with self._pools_mu:
+            pools, self._pools = self._pools, []
+        for pool in pools:
+            for entry in list(pool.values()):
+                try:
+                    entry[0].close()
+                except OSError:  # pragma: no cover - best-effort teardown
+                    pass
+            pool.clear()
 
     def _request(self, method: str, url: str, body: Optional[bytes] = None,
                  content_type: str = "application/json",
@@ -181,7 +205,10 @@ class InternalClient:
                 # Inside the try: an injected send fault (OSError) takes the
                 # SAME classification path as a real one — it is retried
                 # only when the policy below says a real fault would be.
-                failpoints.fire("client-send")
+                # The peer's netloc rides along so chaos tests can target
+                # one node's link (drop/latency/flaky) and leave the rest
+                # of the cluster healthy.
+                failpoints.fire("client-send", target=parts.netloc)
                 conn, fresh = self._conn(parts.scheme, parts.netloc)
                 conn.request(method, path, body=body, headers=headers)
                 sent = True

@@ -594,6 +594,11 @@ class Handler:
             ],
             "quarantined_reads": getattr(executor, "quarantined_reads", 0),
         }
+        # Peer fault-tolerance health: per-peer breaker states plus the
+        # breaker/retry/hedge counters — the evidence for "a blackholed
+        # peer costs zero connect attempts between half-open probes" and
+        # "replica retries stayed inside the budget".
+        out["resilience"] = self.api.server.cluster.health.snapshot()
         from .. import failpoints as _fp
 
         if _fp.active():

@@ -55,7 +55,9 @@ class Server:
         diagnostics_endpoint: str = "",
         member_monitor_interval: float = 2.0,
         member_probe_timeout: float = 2.0,
+        member_probe_failures: int = 3,
         coordinator_failover_probes: int = 3,
+        resilience_config=None,
         internal_key_path: Optional[str] = None,
         scheduler_config=None,
         storage_config=None,
@@ -88,8 +90,14 @@ class Server:
         self.anti_entropy_interval = anti_entropy_interval
         self.cache_flush_interval = cache_flush_interval
         self.member_monitor_interval = member_monitor_interval
+        # Flap damping: consecutive failed heartbeat probes before the
+        # monitor marks a peer unavailable (gossip.probe-failures). One
+        # transient probe timeout must not reroute every shard the peer
+        # owns; <=1 restores the old instant-mark behavior.
+        self.member_probe_failures = max(member_probe_failures, 1)
         self.coordinator_failover_probes = coordinator_failover_probes
-        # node id -> consecutive failed heartbeat probes (feeds failover).
+        # node id -> consecutive failed heartbeat probes (feeds both the
+        # flap damping above and coordinator failover).
         self._probe_failures: dict = {}
         self.metric_poll_interval = metric_poll_interval
         self.primary_translate_store_url = primary_translate_store_url
@@ -103,6 +111,10 @@ class Server:
         self.cluster = Cluster(
             node=self.node, replica_n=replica_n, hasher=hasher
         )
+        # Install the [resilience] knobs on the cluster's health registry
+        # (breakers, retry budget, hedging — cluster/health.py).
+        if resilience_config is not None:
+            self.cluster.health.configure(resilience_config.validate())
         self._static_hosts = cluster_hosts or []
 
         self.holder = Holder(
@@ -497,6 +509,10 @@ class Server:
             self.resize_coordinator.begin(new_nodes)
         else:
             self.cluster.nodes = list(new_nodes)
+            live = {n.id for n in new_nodes}
+            self.cluster.health.prune_absent(live)
+            for nid in [k for k in self._probe_failures if k not in live]:
+                del self._probe_failures[nid]
             self.topology.save(self.cluster.nodes)
             self.broadcast_message(self._status_message())
             for node in extra_recipients:
@@ -519,6 +535,10 @@ class Server:
             self._httpd.server_close()
         if self.collective is not None:
             self.collective.close()
+        # Executor.close also drains the shared internal client's
+        # keep-alive pools; the probe client has its own.
+        self.executor.close()
+        self._probe_client.close()
         self.holder.close()
         self.translate_store.close()
         self.opened = False
@@ -597,11 +617,22 @@ class Server:
             try:
                 status = self._probe_client.status(node.uri)
             except PilosaError:
-                if node.id not in self.cluster.unavailable:
-                    self.logger.info("node %s marked unavailable", node.id)
-                self.cluster.mark_unavailable(node.id)
                 self._probe_failures[node.id] = \
                     self._probe_failures.get(node.id, 0) + 1
+                was_down = node.id in self.cluster.unavailable
+                if was_down or (
+                    self._probe_failures[node.id] >= self.member_probe_failures
+                ):
+                    # Flap damping (gossip.probe-failures): a single
+                    # transient probe timeout no longer reroutes every
+                    # shard the peer owns; a peer the data path already
+                    # ejected stays down without waiting out the streak.
+                    if not was_down:
+                        self.logger.info("node %s marked unavailable "
+                                         "(%d consecutive failed probes)",
+                                         node.id,
+                                         self._probe_failures[node.id])
+                    self.cluster.mark_unavailable(node.id)
                 if node.is_coordinator:
                     self._consider_coordinator_failover(node)
             else:
@@ -845,6 +876,13 @@ class Server:
             prev_state = self.cluster.state
             self.cluster.state = msg.get("state", self.cluster.state)
             self.cluster.nodes = [Node.from_dict(n) for n in msg.get("nodes", [])]
+            # Wholesale membership replacement: drop health/probe state
+            # for ids no longer in the cluster, so a departed node's
+            # stale breaker can't shadow a later re-add of the same id.
+            live = {n.id for n in self.cluster.nodes}
+            self.cluster.health.prune_absent(live)
+            for nid in [k for k in self._probe_failures if k not in live]:
+                del self._probe_failures[nid]
             for n in self.cluster.nodes:
                 # Our own jax process index is authoritative locally; a
                 # status assembled before our join reported it would
@@ -871,7 +909,10 @@ class Server:
             # coordinator from a stale checkpoint (open() restores flags).
             self.topology.save(self.cluster.nodes)
         elif typ == "remove-node":
+            # remove_node prunes the cluster-side health state; the
+            # monitor's probe streak lives here.
             self.cluster.remove_node(msg["nodeID"])
+            self._probe_failures.pop(msg["nodeID"], None)
         elif typ == "recalculate-caches":
             for index in self.holder.indexes.values():
                 for field in index.fields.values():

@@ -39,6 +39,13 @@ def pytest_configure(config):
         "slow: timing-sensitive tests (real micro-batch windows, device "
         "benchmarks) excluded from the tier-1 CPU run",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: network-fault-injection cluster tests (tests/test_chaos.py)."
+        " The deterministic seed-pinned smoke runs in tier-1; the"
+        " randomized sweep is additionally marked slow (CHAOS_SMOKE=1"
+        " shrinks it to the fast deterministic mode).",
+    )
 
 
 class FakeClock:

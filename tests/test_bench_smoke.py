@@ -15,7 +15,7 @@ BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
 
 STANZAS = (
     "hbm", "big", "scale", "open", "import", "serving", "sched", "mixed",
-    "topn_bsi", "time_range",
+    "fault", "topn_bsi", "time_range",
 )
 
 
@@ -58,6 +58,11 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     # must move fewer bytes to the device than delta-off.
     mixed = detail["mixed"]
     assert mixed["delta_ok"], mixed
+    # The FAULT stanza is the resilience acceptance metric: the scripted
+    # brown-out must end with converged routing and a recovery time.
+    fault = detail["fault"]
+    assert fault["recovered"], fault
+    assert fault["recovery_s"] < 30, fault
 
     # BENCH_OUT got the same line atomically.
     assert json.loads(out_path.read_text())["detail"]["mixed"]["delta_ok"]

@@ -1,0 +1,258 @@
+"""Network-chaos harness: a 3-node cluster under injected link faults.
+
+Faults ride the `client-send` failpoint with per-peer targeting
+(failpoints.py network actions: drop / latency(ms) / flaky(p)), so one
+node's links misbehave while the harness's own connection to the query
+head stays clean. The invariant under ANY fault schedule:
+
+    every query either returns the correct result or fails with a typed
+    error (ClientError / PilosaError) — never wrong data;
+
+and once faults clear, routing converges: every breaker re-closes, no
+peer stays marked unavailable, and queries succeed with zero degraded
+reads.
+
+Two tiers:
+  - test_chaos_smoke: deterministic (pinned seed, fake breaker clock,
+    ~10s), runs in tier-1.
+  - test_chaos_randomized: the full randomized sweep, marked `slow`;
+    CHAOS_SMOKE=1 shrinks it to the fast deterministic mode so the whole
+    path can be exercised quickly (seed printed for replay via
+    PILOSA_TPU_CHAOS_SEED).
+"""
+
+import os
+import random
+import socket
+import time
+
+import pytest
+
+from pilosa_tpu import failpoints
+from pilosa_tpu.cluster.hash import ModHasher
+from pilosa_tpu.cluster.health import CLOSED, ResilienceConfig
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.errors import PilosaError
+from pilosa_tpu.server.client import ClientError, InternalClient
+from pilosa_tpu.server.server import Server
+
+from .conftest import FakeClock
+
+pytestmark = pytest.mark.chaos
+
+N_SHARDS = 4
+ROWS = (1, 2, 3)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def chaos_cluster(tmp_path):
+    """3-node replica_n=2 cluster with tight breaker backoffs, manual
+    member-monitor rounds, and a shared fake clock driving every node's
+    breaker timing."""
+    clock = FakeClock()
+    ports = [free_port() for _ in range(3)]
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = []
+    for i, port in enumerate(ports):
+        s = Server(
+            data_dir=str(tmp_path / f"node{i}"),
+            port=port,
+            cluster_hosts=hosts,
+            replica_n=2,
+            hasher=ModHasher(),
+            cache_flush_interval=0,
+            anti_entropy_interval=0,
+            member_monitor_interval=0,  # rounds driven by the test
+            executor_workers=0,
+            resilience_config=ResilienceConfig(
+                breaker_backoff=0.2, breaker_backoff_max=1.0,
+                # Generous budget: the invariant under test is
+                # correctness, not shedding (test_health covers that).
+                retry_budget=50.0, retry_refill=1.0,
+            ),
+        )
+        s.open()
+        s.cluster.health.clock = clock
+        servers.append(s)
+    yield servers, hosts, clock
+    failpoints.reset()
+    for s in servers:
+        try:
+            s.close()
+        except Exception:
+            pass
+
+
+def _load(client, h0):
+    """Deterministic dataset spanning every shard; returns expected
+    Count(Row(f=r)) per row. Idempotent: the randomized sweep replays it
+    on the same cluster once per seed."""
+    client.ensure_index(h0, "cx")
+    client.ensure_field(h0, "cx", "f")
+    time.sleep(0.05)
+    expected = {}
+    for row in ROWS:
+        cols = [s * SHARD_WIDTH + 17 * row + k for s in range(N_SHARDS)
+                for k in range(row)]
+        for col in cols:
+            client.query(h0, "cx", f"Set({col}, f={row})")
+        expected[row] = len(set(cols))
+    # Sanity before faults.
+    for row, want in expected.items():
+        assert client.query(h0, "cx", f"Count(Row(f={row}))")["results"][0] == want
+    return expected
+
+
+def _run_chaos(servers, hosts, clock, seed, rounds, queries_per_round):
+    """Drive seed-pinned randomized faults; assert correct-or-clean-error
+    per query; return (ok_count, err_count)."""
+    rng = random.Random(seed)
+    failpoints.seed(seed)
+    client = InternalClient(timeout=10.0)
+    h0 = hosts[0]
+    expected = _load(client, h0)
+    peers = hosts[1:]  # never fault the harness -> query-head link
+
+    ok = err = 0
+    for _ in range(rounds):
+        failpoints.reset()
+        failpoints.seed(rng.randrange(1 << 30))
+        # 1-2 faulted peer links per round, random action each.
+        for netloc in rng.sample(peers, rng.randint(1, 2)):
+            action = rng.choice(["drop", "flaky", "latency"])
+            arg = {"drop": 0.0, "flaky": 0.6, "latency": 3.0}[action]
+            failpoints.configure(f"client-send@{netloc}", action, arg=arg)
+        for _ in range(queries_per_round):
+            row = rng.choice(ROWS)
+            try:
+                got = client.query(h0, "cx", f"Count(Row(f={row}))")
+            except (ClientError, PilosaError):
+                err += 1  # clean failure: acceptable under faults
+                continue
+            assert got["results"][0] == expected[row], (
+                f"WRONG RESULT under faults (seed={seed}): row {row} "
+                f"got {got['results'][0]} want {expected[row]}"
+            )
+            ok += 1
+        # Let breaker backoffs elapse between rounds so re-admission
+        # probes interleave with new faults.
+        clock.advance(rng.choice([0.0, 0.25, 1.1]))
+
+    # ---- faults clear: routing must converge.
+    failpoints.reset()
+    clock.advance(2.0)  # every backoff elapsed
+    for _ in range(3):
+        for s in servers:
+            s._monitor_members()
+    for s in servers:
+        snap = s.cluster.health.snapshot()
+        for pid, p in snap["peers"].items():
+            assert p["state"] == CLOSED, (
+                f"breaker for {pid} on {s.node.id} stuck {p['state']} "
+                f"(seed={seed}): {snap}"
+            )
+        assert s.cluster.unavailable == set()
+    for row, want in expected.items():
+        got = client.query(h0, "cx", f"Count(Row(f={row}))")
+        assert got["results"][0] == want
+    # Zero degraded reads after recovery: nothing quarantined, nothing
+    # served from an empty fragment.
+    for s in servers:
+        assert s.executor.quarantined_reads == 0
+        assert s.holder.quarantined_fragments() == []
+    assert ok > 0, "chaos run never completed a single successful query"
+    return ok, err
+
+
+def test_chaos_smoke(chaos_cluster):
+    """Deterministic tier-1 smoke: pinned seed, fake breaker clock, small
+    schedule (~10s). Under drop/latency/flaky faults on two of three
+    nodes' links, no query ever returns a wrong count, and routing
+    converges once the faults clear."""
+    servers, hosts, clock = chaos_cluster
+    seed = int(os.environ.get("PILOSA_TPU_CHAOS_SEED", "1207"))
+    _run_chaos(servers, hosts, clock, seed, rounds=6, queries_per_round=5)
+
+
+@pytest.mark.slow
+def test_chaos_randomized(chaos_cluster):
+    """Full randomized sweep (slow): fresh seed per run, printed for
+    replay. CHAOS_SMOKE=1 shrinks it to one fast deterministic pass."""
+    servers, hosts, clock = chaos_cluster
+    if os.environ.get("CHAOS_SMOKE") == "1":
+        seeds, rounds, qpr = [1207], 6, 5
+    else:
+        base = int(os.environ.get("PILOSA_TPU_CHAOS_SEED",
+                                  str(random.randrange(1 << 30))))
+        print(f"chaos: base seed {base} (replay with "
+              f"PILOSA_TPU_CHAOS_SEED={base})")
+        seeds, rounds, qpr = [base + i for i in range(3)], 12, 10
+    for seed in seeds:
+        _run_chaos(servers, hosts, clock, seed, rounds, qpr)
+
+
+def test_network_failpoint_grammar():
+    """The network fault spec grammar parses and reports correctly."""
+    try:
+        failpoints.activate(
+            "client-send@localhost:1=drop;"
+            "client-send@localhost:2=latency(5);"
+            "client-send@localhost:3=3*flaky(0.5)"
+        )
+        active = failpoints.active()
+        assert active["client-send@localhost:1"] == "drop"
+        assert active["client-send@localhost:2"] == "latency(5)"
+        assert active["client-send@localhost:3"] == "3*flaky(0.5)"
+        with pytest.raises(ValueError):
+            failpoints.activate("client-send=flaky(nope)")
+        with pytest.raises(ValueError):
+            failpoints.configure("x", "flaky", arg=1.5)
+    finally:
+        failpoints.reset()
+
+
+def test_targeted_failpoint_scopes_to_peer():
+    """A targeted spec fires only for its peer; a bare spec matches all;
+    the targeted entry wins when both exist."""
+    try:
+        failpoints.configure("client-send@peer-a:1", "drop")
+        failpoints.fire("client-send", target="peer-b:1")  # no match: clean
+        with pytest.raises(failpoints.InjectedFault):
+            failpoints.fire("client-send", target="peer-a:1")
+        assert failpoints.hits("client-send@peer-a:1") == 1
+        failpoints.configure("client-send", "latency", arg=0.0)
+        failpoints.fire("client-send", target="peer-b:1")  # bare latency
+        assert failpoints.hits("client-send") == 1
+        with pytest.raises(failpoints.InjectedFault):
+            failpoints.fire("client-send", target="peer-a:1")  # targeted wins
+    finally:
+        failpoints.reset()
+
+
+def test_flaky_failpoint_is_seed_deterministic():
+    """flaky(p) draws replay bit-identically under the same seed."""
+    def draws(seed):
+        failpoints.reset()
+        failpoints.seed(seed)
+        failpoints.configure("p", "flaky", arg=0.5)
+        out = []
+        for _ in range(32):
+            try:
+                failpoints.fire("p")
+                out.append(0)
+            except failpoints.InjectedFault:
+                out.append(1)
+        failpoints.reset()
+        return out
+
+    a, b = draws(99), draws(99)
+    assert a == b
+    assert 0 < sum(a) < 32  # actually flaky, not constant

@@ -72,6 +72,50 @@ def test_gossip_config_surface(tmp_path, monkeypatch):
         pass  # never opened
 
 
+def test_resilience_config_surface(tmp_path, monkeypatch):
+    """[resilience] + gossip.probe-failures knobs: TOML + env + flag
+    precedence, to_toml round-trip, and build_server wiring into the
+    cluster health registry / member monitor."""
+    cfg_file = tmp_path / "cfg.toml"
+    cfg_file.write_text(
+        "[resilience]\nbreaker-failures = 2\nretry-budget = 5.0\n"
+        "hedge-max-fraction = 0.1\nbreaker-backoff = 0.5\n"
+        "[gossip]\nprobe-failures = 5\n"
+    )
+    cfg = Config.load(str(cfg_file))
+    assert cfg.resilience.breaker_failures == 2
+    assert cfg.resilience.retry_budget == 5.0
+    assert cfg.resilience.hedge_max_fraction == 0.1
+    assert cfg.gossip.probe_failures == 5
+    monkeypatch.setenv("PILOSA_TPU_RESILIENCE_RETRY_BUDGET", "7")
+    cfg = Config.load(str(cfg_file))
+    assert cfg.resilience.retry_budget == 7.0
+    cfg = Config.load(str(cfg_file), {"resilience_retry_budget": 9.0})
+    assert cfg.resilience.retry_budget == 9.0
+    # Round-trips through generate-config output (env cleared: env beats
+    # file, so the lingering override would mask the file's value).
+    monkeypatch.delenv("PILOSA_TPU_RESILIENCE_RETRY_BUDGET")
+    p = tmp_path / "rt.toml"
+    p.write_text(cfg.to_toml())
+    rt = Config.load(str(p))
+    assert rt.resilience.retry_budget == 9.0
+    assert rt.resilience.breaker_failures == 2
+    assert rt.gossip.probe_failures == 5
+
+    cfg.data_dir = str(tmp_path / "d")
+    cfg.bind = "localhost:0"
+    cfg.gossip.probe_interval = 0
+    s = cfg.build_server(executor_workers=0, cache_flush_interval=0)
+    assert s.member_probe_failures == 5
+    assert s.cluster.health.config.retry_budget == 9.0
+    assert s.cluster.health.config.breaker_failures == 2
+
+    # Invalid knobs are rejected at build time, not at first failure.
+    cfg.resilience.hedge_max_fraction = 2.0
+    with pytest.raises(ValueError):
+        cfg.build_server(executor_workers=0)
+
+
 def test_internal_key_enforced(tmp_path):
     """A node with a cluster key refuses unauthenticated /internal/* (the
     memberlist-encryption analog): wrong key -> 403, right key -> 200,

@@ -91,6 +91,12 @@ def test_member_monitor_detects_death_and_recovery(cluster3r):
     assert s0.cluster.unavailable == set()
     port = s1.port
     s1.close()
+    # Flap damping (gossip.probe-failures, default 3): one or two failed
+    # probes are a blip, not a death — routing must not flap.
+    s0._monitor_members()
+    assert s1.node.id not in s0.cluster.unavailable
+    s0._monitor_members()
+    assert s1.node.id not in s0.cluster.unavailable
     s0._monitor_members()
     assert s1.node.id in s0.cluster.unavailable
     # Restart on the same port -> recovery detected.
@@ -286,3 +292,96 @@ def test_import_tolerates_dead_replica(cluster3r):
     primary.api.import_bits("imp", "f", 0, rows.tolist(), cols.tolist())
     assert primary.holder.fragment("imp", "f", "standard", 0).row_count(0) == 100
     assert replica.node.id in primary.cluster.unavailable
+
+
+def test_write_fanout_replica_flap_converges(cluster3r, tmp_path):
+    """tolerant_owner_fanout under a replica that flaps mid-write-stream
+    (alive -> dead -> alive): the surviving owner applies every acked
+    write exactly once, skipped forwards are counted (breaker open, zero
+    connect attempts), and anti-entropy converges the flapped replica
+    back to byte-identical fragment state."""
+    import io
+
+    from pilosa_tpu.cluster.health import CLOSED
+    from pilosa_tpu.cluster.syncer import HolderSyncer
+
+    client = InternalClient()
+    s0 = cluster3r[0]
+    h0 = f"localhost:{s0.port}"
+    client.create_index(h0, "flap")
+    client.create_field(h0, "flap", "f")
+    time.sleep(0.05)
+
+    # A shard s0 owns whose OTHER replica is some other node.
+    target_shard = flap_id = None
+    for shard in range(64):
+        owners = s0.cluster.shard_nodes("flap", shard)
+        if any(n.id == s0.node.id for n in owners):
+            others = [n.id for n in owners if n.id != s0.node.id]
+            if others:
+                target_shard, flap_id = shard, others[0]
+                break
+    assert flap_id is not None
+    flapper = next(s for s in cluster3r if s.node.id == flap_id)
+    base = target_shard * SHARD_WIDTH
+
+    def counter(name):
+        return s0.stats.snapshot()["counters"].get(name, 0)
+
+    # Phase 1: both owners alive.
+    assert client.query(h0, "flap", f"Set({base + 1}, f=9)")["results"][0]
+
+    # Phase 2: replica dies mid-stream. The first write pays the failed
+    # forward; later writes skip without a connect attempt.
+    flap_port, flap_dir = flapper.port, flapper.data_dir
+    flapper.close()
+    assert client.query(h0, "flap", f"Set({base + 2}, f=9)")["results"][0]
+    assert counter("WriteForwardFailed") >= 1
+    assert flap_id in s0.cluster.unavailable
+    skipped_before = counter("WriteForwardSkipped")
+    assert client.query(h0, "flap", f"Set({base + 3}, f=9)")["results"][0]
+    assert counter("WriteForwardSkipped") > skipped_before
+    assert s0.cluster.health.counters["breaker_short_circuits"] >= 1
+
+    # Phase 3: replica returns (same id, same data dir). The monitor's
+    # successful probe recloses the breaker; writes forward again.
+    flapper2 = Server(
+        data_dir=flap_dir,
+        port=flap_port,
+        cluster_hosts=[n.uri for n in s0.cluster.nodes],
+        replica_n=2,
+        hasher=ModHasher(),
+        cache_flush_interval=0,
+        anti_entropy_interval=0,
+        member_monitor_interval=0,
+        executor_workers=0,
+    )
+    flapper2.open()
+    try:
+        s0._monitor_members()
+        assert flap_id not in s0.cluster.unavailable
+        assert s0.cluster.health.state(flap_id) == CLOSED
+        assert client.query(h0, "flap", f"Set({base + 4}, f=9)")["results"][0]
+
+        # No double-apply on the surviving owner: exactly the 4 distinct
+        # bits, each applied once (a replayed Set would return False and
+        # not change the count, a double-applied forward would diverge
+        # replicas — both show up as a count mismatch somewhere below).
+        frag0 = s0.holder.fragment("flap", "f", "standard", target_shard)
+        assert frag0.row_count(9) == 4
+        # The flapped replica missed bits 2 and 3.
+        fragX = flapper2.holder.fragment("flap", "f", "standard", target_shard)
+        assert fragX is not None and fragX.row_count(9) == 2
+
+        # Phase 4: anti-entropy converges the flapped replica
+        # byte-identically with the survivor.
+        HolderSyncer(s0).sync_holder()
+        time.sleep(0.05)
+        fragX = flapper2.holder.fragment("flap", "f", "standard", target_shard)
+        assert fragX.row_count(9) == 4
+        b0, bX = io.BytesIO(), io.BytesIO()
+        frag0.write_to(b0)
+        fragX.write_to(bX)
+        assert b0.getvalue() == bX.getvalue()
+    finally:
+        flapper2.close()
