@@ -105,6 +105,19 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                         "(sync per write)")
     p.add_argument("--storage-fsync-batch-ops", dest="storage_fsync_batch_ops",
                    type=int, help="ops between WAL fsyncs in batch mode")
+    p.add_argument("--storage-snapshot-ratio", dest="storage_snapshot_ratio",
+                   type=float,
+                   help="snapshot a fragment when its op-log bytes exceed "
+                        "this fraction of its storage bytes (0 disables the "
+                        "byte trigger)")
+    p.add_argument("--storage-snapshot-interval",
+                   dest="storage_snapshot_interval", type=float,
+                   help="background sweep seconds: snapshot any fragment "
+                        "carrying WAL bytes older than this (0 disables)")
+    p.add_argument("--ingest-import-workers", dest="ingest_import_workers",
+                   type=int,
+                   help="max shard batches of one bulk import applied/"
+                        "forwarded concurrently (1 = serial)")
     p.add_argument("--engine-delta-max-fraction",
                    dest="engine_delta_max_fraction", type=float,
                    help="max changed fraction of a resident device tensor "

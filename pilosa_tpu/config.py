@@ -71,6 +71,10 @@ from .sched import SchedulerConfig as SchedConfig  # noqa: E402
 # the storage layer it governs. See docs/durability.md.
 from .storage import StorageConfig  # noqa: E402
 
+# And for [ingest]: the bulk-import fan-out knobs (server/api.py's
+# parallel shard routing). See docs/ingest.md.
+from .ingest import IngestConfig  # noqa: E402
+
 # And for [engine]: the device-cache refresh knobs live with the parallel
 # engine (pilosa_tpu/parallel/__init__.py, jax-free so CLI startup stays
 # light). See docs/engine-caches.md.
@@ -120,6 +124,7 @@ class Config:
     gossip: GossipConfig = field(default_factory=GossipConfig)
     scheduler: SchedConfig = field(default_factory=SchedConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
+    ingest: IngestConfig = field(default_factory=IngestConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
@@ -196,6 +201,13 @@ class Config:
         self.storage.fsync = st.get("fsync", self.storage.fsync)
         self.storage.fsync_batch_ops = st.get(
             "fsync-batch-ops", self.storage.fsync_batch_ops)
+        self.storage.snapshot_ratio = st.get(
+            "snapshot-ratio", self.storage.snapshot_ratio)
+        self.storage.snapshot_interval = st.get(
+            "snapshot-interval", self.storage.snapshot_interval)
+        ing = d.get("ingest", {})
+        self.ingest.import_workers = ing.get(
+            "import-workers", self.ingest.import_workers)
         e = d.get("engine", {})
         self.engine.delta_max_fraction = e.get(
             "delta-max-fraction", self.engine.delta_max_fraction)
@@ -290,10 +302,15 @@ class Config:
         for attr, name, cast in [
             ("fsync", "STORAGE_FSYNC", str),
             ("fsync_batch_ops", "STORAGE_FSYNC_BATCH_OPS", int),
+            ("snapshot_ratio", "STORAGE_SNAPSHOT_RATIO", float),
+            ("snapshot_interval", "STORAGE_SNAPSHOT_INTERVAL", float),
         ]:
             v = env(name, cast)
             if v is not None:
                 setattr(self.storage, attr, v)
+        v = env("INGEST_IMPORT_WORKERS", int)
+        if v is not None:
+            self.ingest.import_workers = v
         for attr, name, cast in [
             ("delta_max_fraction", "ENGINE_DELTA_MAX_FRACTION", float),
             ("delta_journal_ops", "ENGINE_DELTA_JOURNAL_OPS", int),
@@ -355,6 +372,9 @@ class Config:
             "sched_batch_max": ("scheduler", "batch_max"),
             "storage_fsync": ("storage", "fsync"),
             "storage_fsync_batch_ops": ("storage", "fsync_batch_ops"),
+            "storage_snapshot_ratio": ("storage", "snapshot_ratio"),
+            "storage_snapshot_interval": ("storage", "snapshot_interval"),
+            "ingest_import_workers": ("ingest", "import_workers"),
             "engine_delta_max_fraction": ("engine", "delta_max_fraction"),
             "engine_delta_journal_ops": ("engine", "delta_journal_ops"),
             "engine_gather_workers": ("engine", "gather_workers"),
@@ -432,6 +452,11 @@ class Config:
             "[storage]",
             f"fsync = {fmt(self.storage.fsync)}",
             f"fsync-batch-ops = {self.storage.fsync_batch_ops}",
+            f"snapshot-ratio = {self.storage.snapshot_ratio}",
+            f"snapshot-interval = {self.storage.snapshot_interval}",
+            "",
+            "[ingest]",
+            f"import-workers = {self.ingest.import_workers}",
             "",
             "[engine]",
             f"delta-max-fraction = {self.engine.delta_max_fraction}",
@@ -492,6 +517,7 @@ class Config:
             internal_key_path=self.gossip.key or None,
             scheduler_config=self.scheduler,
             storage_config=self.storage.validate(),
+            ingest_config=self.ingest.validate(),
             engine_config=self.engine,
             resilience_config=self.resilience.validate(),
         )

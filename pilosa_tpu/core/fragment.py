@@ -22,6 +22,7 @@ import itertools
 import os
 import struct
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -40,7 +41,15 @@ from .. import failpoints
 from ..errors import ColumnRowOutOfRangeError, CorruptFragmentError, PilosaError
 from ..ops import bitplane as bp
 from ..storage import FSYNC_ALWAYS, FSYNC_NEVER, StorageConfig
-from ..storage.bitmap import OP_ADD, OP_REMOVE, Bitmap, _as_container, encode_op
+from ..storage.bitmap import (
+    OP_ADD,
+    OP_REMOVE,
+    OP_SIZE,
+    Bitmap,
+    _as_container,
+    encode_bulk_op,
+    encode_op,
+)
 from .cache import NopCache, Pair, new_cache, sort_pairs
 from .row import Row
 
@@ -149,6 +158,7 @@ class Fragment:
         epoch: Optional[WriteEpoch] = None,
         storage_config: Optional[StorageConfig] = None,
         delta_journal_ops: Optional[int] = None,
+        snapshotter=None,
     ):
         self.path = path
         self.index = index
@@ -166,6 +176,27 @@ class Fragment:
         self.storage_config = storage_config or StorageConfig()
         # WAL appends since the last fsync (drives the `batch` fsync mode).
         self._unsynced_ops = 0
+        # Snapshot-trigger accounting (docs/ingest.md): op-log bytes
+        # appended since the last snapshot vs. the container-section bytes
+        # that snapshot wrote. The policy (snapshot_due) fires when the
+        # log exceeds storage.snapshot-ratio x the base — write cost stays
+        # O(batch) with total snapshot I/O amortized geometrically.
+        self.wal_bytes = 0
+        self.storage_bytes = 0
+        # monotonic time of the FIRST append since the last snapshot:
+        # the snapshotter's periodic sweep ages fragments on it.
+        self.wal_since: Optional[float] = None
+        # Background snapshotter (storage/snapshotter.py), threaded down
+        # Holder -> Index -> Field -> View like storage_config. None =
+        # snapshot inline (standalone fragments keep today's synchronous
+        # semantics; tests rely on them).
+        self._snapshotter = snapshotter
+        # Bumped by every COMPLETED storage-file rewrite. A background
+        # snapshot records it at handoff and aborts its rename if an
+        # inline snapshot / replica restore rewrote the file meanwhile —
+        # renaming a stale rewrite over a newer file would resurrect
+        # folded-away ops.
+        self._snapshot_seq = 0
         # Crash-safety state: quarantined means the on-disk file failed
         # validation at open — the bad bytes were moved aside to
         # `<path>.corrupt` (corrupt_path) and this fragment serves/accepts
@@ -230,9 +261,10 @@ class Fragment:
             # truth; the partial rewrite is garbage. Remove it BEFORE
             # parsing so a later snapshot can't rename torn bytes into
             # place.
-            tmp = self.path + ".snapshotting"
-            if os.path.exists(tmp):
-                os.remove(tmp)
+            for tmp in (self.path + ".snapshotting",
+                        self.path + ".snapshotting.bg"):
+                if os.path.exists(tmp):
+                    os.remove(tmp)
         if self.path and os.path.exists(self.path):
             size = os.path.getsize(self.path)
             if size:
@@ -257,6 +289,11 @@ class Fragment:
                     self._quarantine(e)
                 else:
                     self.op_n = self.storage.op_n
+                    self.wal_bytes = self.storage.ops_bytes
+                    if self.wal_bytes:
+                        self.wal_since = time.monotonic()
+                    self.storage_bytes = (
+                        self.storage.valid_len - self.storage.ops_bytes)
                     if self.storage.truncated_bytes:
                         # Torn WAL tail (crash mid-append): every complete
                         # op was replayed; cut the file back to the last
@@ -272,7 +309,10 @@ class Fragment:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
             if not os.path.exists(self.path):
                 with open(self.path, "wb") as f:
-                    self.storage.write_to(f)
+                    # Captured so storage_bytes + wal_bytes is ALWAYS the
+                    # valid file length (the torn-append truncation and
+                    # the snapshot ratio trigger both rely on it).
+                    self.storage_bytes = self.storage.write_to(f)
             self._wal = open(self.path, "ab")
             if not self.quarantined and os.path.exists(self.path + ".corrupt"):
                 # A .corrupt sibling left by a previous run whose quarantine
@@ -368,6 +408,40 @@ class Fragment:
     def row_count(self, row_id: int) -> int:
         start = row_id * SHARD_WIDTH
         return self.storage.count_range(start, start + SHARD_WIDTH)
+
+    def row_counts(self, row_ids) -> np.ndarray:
+        """Cardinalities of many rows with ONE batched key search —
+        batching the per-row `row_count` calls a bulk import makes. Only
+        the TOUCHED rows' containers are visited (never the whole
+        fragment: a lazily-opened multi-GB file must not be paged in and
+        popcounted because 10 bits landed in one row). Rows are
+        container-aligned at the default shard width; the non-aligned
+        fallback keeps exotic PILOSA_TPU_SHARD_WIDTH_EXP settings
+        correct."""
+        row_ids = np.asarray(row_ids, dtype=np.int64)
+        if len(row_ids) == 0:
+            return np.zeros(0, dtype=np.int64)
+        if SHARD_WIDTH % (1 << 16):
+            return np.array(
+                [self.row_count(int(r)) for r in row_ids], dtype=np.int64)
+        cpr = SHARD_WIDTH >> 16  # containers per row
+        keys = self.storage._sorted_keys()
+        out = np.zeros(len(row_ids), dtype=np.int64)
+        if not len(keys):
+            return out
+        lo = np.searchsorted(keys, row_ids * cpr)
+        hi = np.searchsorted(keys, (row_ids + 1) * cpr)
+        for i in range(len(row_ids)):
+            total = 0
+            for k in keys[lo[i]:hi[i]]:
+                c = self.storage.containers.get(int(k))
+                if c is None:  # dropped by a concurrent writer
+                    continue
+                c = _as_container(c)
+                c.verify_n()
+                total += c.n
+            out[i] = total
+        return out
 
     def rows(self) -> List[int]:
         """Row ids with at least one bit set."""
@@ -482,18 +556,109 @@ class Fragment:
     def _append_op(self, typ: int, pos: int) -> None:
         if self._wal:
             failpoints.fire("wal-append")
-            self._wal.write(encode_op(typ, pos))
-            self._wal.flush()
-            mode = self.storage_config.fsync
-            if mode == FSYNC_ALWAYS:
-                os.fsync(self._wal.fileno())
-            elif mode != FSYNC_NEVER:
-                self._unsynced_ops += 1
-                if self._unsynced_ops >= self.storage_config.fsync_batch_ops:
-                    os.fsync(self._wal.fileno())
-                    self._unsynced_ops = 0
+            try:
+                self._wal.write(encode_op(typ, pos))
+                self._wal.flush()
+            except OSError:
+                self._truncate_torn_append()
+                raise
+            if self.wal_bytes == 0:
+                self.wal_since = time.monotonic()
+            self.wal_bytes += OP_SIZE
+            self._fsync_policy()
         self.op_n += 1
+        self._maybe_snapshot()
+
+    def _truncate_torn_append(self) -> None:
+        """A failed append (ENOSPC, I/O error) may have left a PARTIAL
+        record at the WAL tail. The fragment stays open for writes, so a
+        later successful append would bury that garbage MID-log — which
+        reopen rightly classifies as bit rot and quarantines, losing the
+        whole fragment to what was a transient write failure. Cut the
+        file back to the last whole-record boundary now; the invariant
+        storage_bytes + wal_bytes == valid file length makes the
+        boundary known without a parse."""
+        valid = self.storage_bytes + self.wal_bytes
+        try:
+            self._wal.close()
+        except OSError:
+            pass
+        self._wal = None
+        try:
+            os.truncate(self.path, valid)
+        except OSError:
+            pass  # reopen-time recovery still sees a torn FINAL record
+        # Restore the append handle — a None _wal would silently skip WAL
+        # logging for every later acknowledged write.
+        self._wal = open(self.path, "ab")
+
+    def _append_bulk_op(self, adds, removes) -> None:
+        """Append ONE WAL record covering a whole import batch — the
+        amortized replacement for the snapshot that used to end every
+        bulk mutation. The in-memory mutation is already applied; crash
+        safety comes from record replay at reopen (torn tails truncate,
+        exactly like point ops)."""
+        if self._wal:
+            failpoints.fire("bulk-wal-append")
+            rec = encode_bulk_op(adds, removes)
+            try:
+                self._wal.write(rec)
+                self._wal.flush()
+            except OSError:
+                # A multi-MB record makes a partial flush realistic:
+                # truncate it away or the next append buries it mid-log.
+                self._truncate_torn_append()
+                raise
+            if self.wal_bytes == 0:
+                self.wal_since = time.monotonic()
+            self.wal_bytes += len(rec)
+            if self.storage_config.fsync != FSYNC_NEVER:
+                # One fsync per bulk record, O(batch): the old
+                # snapshot-per-batch path fsynced every acked import, so
+                # riding the `batch` op counter here would silently leave
+                # up to fsync-batch-ops-1 whole acked BATCHES in the page
+                # cache across a power loss. The amortization win was the
+                # removed O(fragment) file rewrite, not this fsync.
+                os.fsync(self._wal.fileno())
+                self._unsynced_ops = 0
+        self.op_n += 1
+
+    def _fsync_policy(self) -> None:
+        mode = self.storage_config.fsync
+        if mode == FSYNC_ALWAYS:
+            os.fsync(self._wal.fileno())
+        elif mode != FSYNC_NEVER:
+            self._unsynced_ops += 1
+            if self._unsynced_ops >= self.storage_config.fsync_batch_ops:
+                os.fsync(self._wal.fileno())
+                self._unsynced_ops = 0
+
+    # ---------------------------------------------------- snapshot triggers
+
+    def snapshot_due(self) -> bool:
+        """Snapshot-trigger policy: op count (the reference's 2000-op
+        threshold) OR op-log bytes exceeding snapshot-ratio x the last
+        snapshot's container bytes (floored so a fresh fragment's first
+        batches don't each trigger)."""
         if self.op_n >= self.max_op_n:
+            return True
+        ratio = self.storage_config.snapshot_ratio
+        if ratio and self.wal_bytes > ratio * max(
+                self.storage_bytes, StorageConfig.SNAPSHOT_MIN_BASE):
+            return True
+        return False
+
+    def _maybe_snapshot(self) -> None:
+        if self.snapshot_due():
+            self._request_snapshot()
+
+    def _request_snapshot(self) -> None:
+        """Snapshot now (inline) or hand the fragment to the holder's
+        background snapshotter so the write path never blocks on
+        snapshot I/O."""
+        if self._snapshotter is not None and self.path:
+            self._snapshotter.enqueue(self)
+        else:
             self.snapshot()
 
     # ------------------------------------------------------------------ BSI
@@ -879,24 +1044,44 @@ class Fragment:
             return
         self.storage.add_many(add_pos)
         self.storage.remove_many(rem_pos)
+        self._append_bulk_op(add_pos, rem_pos)
         allpos = np.concatenate([add_pos, rem_pos])
-        rows = allpos // np.uint64(SHARD_WIDTH)
         # Anti-entropy fold-back stays delta-refreshable: the diff positions
         # ARE the dirty words (journaled unless the diff alone would blow
         # the journal bound).
-        w64s = (allpos % np.uint64(SHARD_WIDTH)) >> np.uint64(6)
-        journal = len(allpos) <= self.delta_journal_ops
-        for row_id in np.unique(rows):
-            words = np.unique(w64s[rows == row_id]) if journal else None
-            self._invalidate_row(int(row_id), words)
-            self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
-        self.cache.invalidate(force=True)
-        self.snapshot()
+        self._invalidate_bulk(allpos // np.uint64(SHARD_WIDTH), allpos)
+        self._maybe_snapshot()
 
     # --------------------------------------------------------------- import
 
+    def _invalidate_bulk(self, row_ids: np.ndarray, positions: np.ndarray) -> None:
+        """Cache/journal maintenance for a bulk mutation, grouped by row
+        with one argsort + searchsorted pass (the old per-row
+        `row_ids == row_id` mask loop cost O(rows x batch)). Imports small
+        enough to journal keep resident planes delta-refreshable
+        (positions overapproximate: an already-set bit journals a word
+        that didn't change — extra words are re-read, never wrong); big
+        imports poison the touched rows."""
+        journal = len(positions) <= self.delta_journal_ops
+        order = np.argsort(row_ids, kind="stable")
+        rows_sorted = row_ids[order]
+        uniq_rows, starts = np.unique(rows_sorted, return_index=True)
+        bounds = np.append(starts, len(rows_sorted))
+        w64_sorted = ((positions % np.uint64(SHARD_WIDTH)) >> np.uint64(6))[order]
+        counts = self.row_counts(uniq_rows)
+        for i, row_id in enumerate(uniq_rows):
+            words = (np.unique(w64_sorted[bounds[i]:bounds[i + 1]])
+                     if journal else None)
+            self._invalidate_row(int(row_id), words)
+            self.cache.bulk_add(int(row_id), int(counts[i]))
+        self.cache.invalidate(force=True)
+
     def bulk_import(self, row_ids: np.ndarray, column_ids: np.ndarray) -> None:
-        """Set many bits at once, then snapshot (reference fragment.go:1298)."""
+        """Set many bits at once (reference fragment.go:1298), amortized:
+        ONE bulk-set WAL record instead of the full-file snapshot that
+        used to end every batch — ingest cost is O(batch); the snapshot
+        policy (snapshot_due) decides when the file is rewritten, off the
+        hot path when a background snapshotter is attached."""
         row_ids = np.asarray(row_ids, dtype=np.uint64)
         column_ids = np.asarray(column_ids, dtype=np.uint64)
         positions = row_ids * np.uint64(SHARD_WIDTH) + (
@@ -904,23 +1089,31 @@ class Fragment:
         )
         with self._mu:
             self.storage.add_many(positions)
-            # Imports small enough to journal keep resident planes
-            # delta-refreshable (positions overapproximate: an already-set
-            # bit journals a word that didn't change — extra words are
-            # re-read, never wrong). Big imports poison the touched rows.
-            journal = len(positions) <= self.delta_journal_ops
-            w64s = (positions % np.uint64(SHARD_WIDTH)) >> np.uint64(6)
-            for row_id in np.unique(row_ids):
-                words = np.unique(w64s[row_ids == row_id]) if journal else None
-                self._invalidate_row(int(row_id), words)
-                self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
-            self.cache.invalidate(force=True)
-            self.snapshot()
+            self._append_bulk_op(positions, None)
+            self._invalidate_bulk(row_ids, positions)
+            self._maybe_snapshot()
+
+    def remove_bulk(self, row_ids: np.ndarray, column_ids: np.ndarray) -> None:
+        """Clear many bits at once — bulk_import's write-path twin (one
+        bulk-clear WAL record, snapshot deferred to policy)."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        positions = row_ids * np.uint64(SHARD_WIDTH) + (
+            column_ids % np.uint64(SHARD_WIDTH)
+        )
+        with self._mu:
+            self.storage.remove_many(positions)
+            self._append_bulk_op(None, positions)
+            self._invalidate_bulk(row_ids, positions)
+            self._maybe_snapshot()
 
     def import_value(
         self, column_ids: np.ndarray, values: np.ndarray, bit_depth: int
     ) -> None:
-        """Bulk BSI import (reference fragment.go:1361-1397)."""
+        """Bulk BSI import (reference fragment.go:1361-1397), amortized:
+        the per-plane on/off scatters land in ONE bsi-import WAL record
+        (adds and removes are disjoint positions, so replay order within
+        the record is immaterial) instead of a snapshot."""
         with self._mu:
             column_ids = np.asarray(column_ids, dtype=np.uint64) % np.uint64(SHARD_WIDTH)
             values = np.asarray(values, dtype=np.uint64)
@@ -929,6 +1122,7 @@ class Fragment:
             w_all = np.unique(column_ids >> np.uint64(6))
             journal = len(w_all) * (bit_depth + 1) <= self.delta_journal_ops
             words = w_all if journal else None
+            adds, removes = [], []
             for i in range(bit_depth):
                 mask = (values >> np.uint64(i)) & np.uint64(1)
                 on = column_ids[mask == 1]
@@ -936,10 +1130,18 @@ class Fragment:
                 base = np.uint64(i * SHARD_WIDTH)
                 self.storage.add_many(on + base)
                 self.storage.remove_many(off + base)
+                adds.append(on + base)
+                removes.append(off + base)
                 self._invalidate_row(i, words)
-            self.storage.add_many(column_ids + np.uint64(bit_depth * SHARD_WIDTH))
+            exists = column_ids + np.uint64(bit_depth * SHARD_WIDTH)
+            self.storage.add_many(exists)
+            adds.append(exists)
             self._invalidate_row(bit_depth, words)
-            self.snapshot()
+            self._append_bulk_op(
+                np.concatenate(adds) if adds else None,
+                np.concatenate(removes) if removes else None,
+            )
+            self._maybe_snapshot()
 
     # ---------------------------------------------------------- persistence
 
@@ -953,6 +1155,8 @@ class Fragment:
             self.storage.optimize()
             if not self.path:
                 self.op_n = 0
+                self.wal_bytes = 0
+                self._snapshot_seq += 1
                 return
             if self._wal:
                 self._wal.close()
@@ -961,7 +1165,7 @@ class Fragment:
             tmp = self.path + ".snapshotting"
             try:
                 with open(tmp, "wb") as f:
-                    self.storage.write_to(f)
+                    written = self.storage.write_to(f)
                     if durable:
                         # fsync BEFORE rename: os.replace is atomic in the
                         # namespace but says nothing about data blocks — a
@@ -999,9 +1203,118 @@ class Fragment:
                 raise
             self.op_n = 0
             self._unsynced_ops = 0
+            self.wal_bytes = 0
+            self.wal_since = None
+            self.storage_bytes = written
+            self._snapshot_seq += 1
             self._wal = open(self.path, "ab")
             if self.stats:
                 self.stats.count("snapshot", 1)
+
+    def snapshot_background(self) -> bool:
+        """Storage-file rewrite with readers AND writers live — the
+        background snapshotter's entry point. Handoff under a brief mutex
+        hold (optimize + copy-on-write container clone + WAL boundary),
+        then serialize/write/fsync entirely OFF-lock; the mutex is
+        retaken only at the rename boundary, long enough to splice the
+        ops appended mid-snapshot onto the new file (so the rename can
+        never lose an acked write) and swap the WAL handle. The mmap
+        double-buffer design (see open()) keeps live views valid across
+        the inode replacement. Returns True when mid-snapshot writes
+        alone re-trigger the snapshot policy (caller re-queues)."""
+        with self._mu:
+            if not self._opened or not self.path or self._wal is None:
+                return False
+            self.storage.optimize()
+            snap = self.storage.cow_clone()
+            self._wal.flush()
+            base_len = os.fstat(self._wal.fileno()).st_size
+            seq = self._snapshot_seq
+            op_base = self.op_n
+        durable = self.storage_config.fsync != FSYNC_NEVER
+        # Distinct temp name from the inline path: an inline snapshot
+        # racing this one (replica restore, explicit flush) must never
+        # share a half-written temp file. open() cleans both leftovers.
+        tmp = self.path + ".snapshotting.bg"
+        try:
+            # The write/fsync phase: entirely off-lock. Tests stall HERE
+            # via failpoint and prove readers/writers still complete.
+            failpoints.fire("snapshot-write")
+            with open(tmp, "wb") as f:
+                snap_bytes = snap.to_bytes()
+                f.write(snap_bytes)
+                if durable:
+                    f.flush()
+                    os.fsync(f.fileno())
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            # Disarm copy-on-write too: leaving it set would make every
+            # later first-touch mutation (and the next handoff's
+            # optimize) pay needless container copies.
+            with self._mu:
+                self.storage._cow = None
+            raise
+        with self._mu:
+            # The clone is fully serialized: stop copy-on-write so later
+            # mutations go back to mutating in place.
+            self.storage._cow = None
+            if (not self._opened or self._wal is None
+                    or self._snapshot_seq != seq):
+                # Fragment closed, or an inline snapshot / replica restore
+                # already rewrote the file: this rewrite is stale.
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                return False
+            try:
+                self._wal.flush()
+                cur = os.fstat(self._wal.fileno()).st_size
+                tail = b""
+                if cur > base_len:
+                    # Ops appended mid-snapshot: their in-memory effect is
+                    # NOT in the clone, so carry their WAL records over.
+                    with open(self.path, "rb") as src:
+                        src.seek(base_len)
+                        tail = src.read(cur - base_len)
+                    with open(tmp, "ab") as f:
+                        f.write(tail)
+                        if durable:
+                            f.flush()
+                            os.fsync(f.fileno())
+                failpoints.fire("snapshot-rename")
+                os.replace(tmp, self.path)
+            except OSError:
+                # The original file (containers + full op log) is still the
+                # durable truth and the WAL handle still points at it.
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+            # Swap the append handle to the new inode BEFORE the directory
+            # fsync: if that fsync fails, later appends must still land on
+            # the file now visible at self.path.
+            self._wal.close()
+            self._wal = open(self.path, "ab")
+            self._unsynced_ops = 0
+            self.op_n -= op_base  # ops since handoff stay pending
+            self.wal_bytes = len(tail)
+            self.wal_since = time.monotonic() if tail else None
+            self.storage_bytes = len(snap_bytes)
+            self._snapshot_seq += 1
+            if durable:
+                dfd = os.open(os.path.dirname(self.path), os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            if self.stats:
+                self.stats.count("snapshot", 1)
+            return self.snapshot_due()
 
     def cache_path(self) -> Optional[str]:
         return self.path + ".cache" if self.path else None

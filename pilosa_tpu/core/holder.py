@@ -29,6 +29,21 @@ class Holder:
         self.indexes: Dict[str, Index] = {}
         self._lock = threading.RLock()
         self.opened = False
+        # Background snapshotter (storage/snapshotter.py): fragments whose
+        # snapshot policy fires enqueue here so the write path never blocks
+        # on snapshot I/O. Only persistent holders get one — pathless
+        # (in-memory) holders snapshot inline, keeping tests and benches
+        # synchronous.
+        self.snapshotter = None
+        if path:
+            from ..storage import StorageConfig
+            from ..storage.snapshotter import Snapshotter
+
+            cfg = storage_config or StorageConfig()
+            self.snapshotter = Snapshotter(
+                stats=stats, interval=cfg.snapshot_interval,
+                fragments_fn=self._all_fragments,
+            )
 
     def open(self) -> "Holder":
         # Per-fragment corruption is handled BELOW this walk: a fragment
@@ -47,13 +62,21 @@ class Holder:
                     broadcast_shard=self.broadcast_shard,
                     storage_config=self.storage_config,
                     delta_journal_ops=self.delta_journal_ops,
+                    snapshotter=self.snapshotter,
                 )
                 index.open()
                 self.indexes[name] = index
+        if self.snapshotter is not None:
+            self.snapshotter.start()
         self.opened = True
         return self
 
     def close(self) -> None:
+        # Stop + drain the snapshotter FIRST: its thread must not race the
+        # fragment closes below (queued rewrites either finish against
+        # still-open fragments or abort on the _opened flag).
+        if self.snapshotter is not None:
+            self.snapshotter.close()
         for index in list(self.indexes.values()):
             index.close()
         self.opened = False
@@ -90,6 +113,7 @@ class Holder:
             broadcast_shard=self.broadcast_shard,
             storage_config=self.storage_config,
             delta_journal_ops=self.delta_journal_ops,
+            snapshotter=self.snapshotter,
         )
         index.open()
         index.save_meta()
@@ -151,6 +175,29 @@ class Holder:
                     for frag in list(view.fragments.values()):
                         if frag.quarantined:
                             out.append(frag)
+        return out
+
+    def _all_fragments(self) -> List[Fragment]:
+        """Every live fragment (list() snapshots at each level: callers
+        include the snapshotter's periodic sweep thread)."""
+        out = []
+        for index in list(self.indexes.values()):
+            for field in list(index.fields.values()):
+                for view in list(field.views.values()):
+                    out.extend(list(view.fragments.values()))
+        return out
+
+    def ingest_stats(self) -> dict:
+        """Aggregate ingest/snapshot health for /debug/vars' `ingest`
+        group and diagnostics: un-snapshotted WAL bytes across all
+        fragments plus the background snapshotter's counters."""
+        out = {"wal_bytes": sum(f.wal_bytes for f in self._all_fragments())}
+        if self.snapshotter is not None:
+            out.update(self.snapshotter.snapshot())
+        else:
+            out.update({"snapshots_deferred": 0, "snapshots_taken": 0,
+                        "snapshots_requeued": 0, "snapshot_errors": 0,
+                        "snapshot_queue_depth": 0})
         return out
 
     def flush_caches(self) -> None:

@@ -41,6 +41,7 @@ class Index:
         broadcast_shard=None,
         storage_config=None,
         delta_journal_ops=None,
+        snapshotter=None,
     ):
         validate_name(name)
         self.path = path
@@ -50,6 +51,7 @@ class Index:
         self.broadcast_shard = broadcast_shard
         self.storage_config = storage_config
         self.delta_journal_ops = delta_journal_ops
+        self.snapshotter = snapshotter
         # Index-wide write epoch: every fragment mutation in this index
         # bumps it (core/fragment.py WriteEpoch). The query micro-batcher
         # keys coalescing groups on it so a batch never mixes queries
@@ -88,6 +90,7 @@ class Index:
                     epoch=self.write_epoch,
                     storage_config=self.storage_config,
                     delta_journal_ops=self.delta_journal_ops,
+                    snapshotter=self.snapshotter,
                 )
                 field.open()
                 self.fields[fname] = field
@@ -136,6 +139,7 @@ class Index:
             epoch=self.write_epoch,
             storage_config=self.storage_config,
             delta_journal_ops=self.delta_journal_ops,
+            snapshotter=self.snapshotter,
         )
         field.open()
         field.save_meta()

@@ -31,6 +31,7 @@ class View:
         epoch=None,
         storage_config=None,
         delta_journal_ops=None,
+        snapshotter=None,
     ):
         self.path = path
         self.index = index
@@ -44,6 +45,7 @@ class View:
         self.epoch = epoch
         self.storage_config = storage_config
         self.delta_journal_ops = delta_journal_ops
+        self.snapshotter = snapshotter
         self.fragments: Dict[int, Fragment] = {}
         self._lock = threading.RLock()
 
@@ -84,6 +86,7 @@ class View:
             epoch=self.epoch,
             storage_config=self.storage_config,
             delta_journal_ops=self.delta_journal_ops,
+            snapshotter=self.snapshotter,
         )
 
     def fragment(self, shard: int) -> Optional[Fragment]:

@@ -98,6 +98,20 @@ class DiagnosticsCollector:
             info["engineStackDeltaHits"] = c.get("stack_delta_hits", 0)
             info["engineDeltaBytes"] = c.get("delta_bytes", 0)
             info["engineFullRefreshBytes"] = c.get("full_refresh_bytes", 0)
+        # Ingest/snapshot shape: WAL bytes awaiting a snapshot and how the
+        # background snapshotter is keeping up. A deployment whose
+        # ingestWalBytes climbs while snapshot counters stall is ingesting
+        # faster than it can rewrite storage (recovery replay grows).
+        if hasattr(holder, "ingest_stats"):
+            snap = holder.ingest_stats()
+            info["ingestWalBytes"] = snap.get("wal_bytes", 0)
+            info["ingestSnapshotsDeferred"] = snap.get("snapshots_deferred", 0)
+            info["ingestSnapshotsTaken"] = snap.get("snapshots_taken", 0)
+            info["ingestSnapshotQueueDepth"] = snap.get(
+                "snapshot_queue_depth", 0)
+        api = getattr(self.server, "api", None)
+        if api is not None:
+            info["ingestImportBatches"] = getattr(api, "import_batches", 0)
         # Peer fault-tolerance shape: how often breakers tripped, whether
         # replica retries ran into the budget, and how much traffic was
         # hedged — the aggregate story of how rough this node's network

@@ -1083,6 +1083,40 @@ class Executor:
 
     # --------------------------------------------------------------- writes
 
+    def _forward_tolerant(self, node, send, errors, note_app_error,
+                          what: str = ""):
+        """THE per-target write-tolerance step (one implementation for
+        the single-shard and the group fan-outs): breaker short-circuit
+        (don't pay a connect timeout per write; an elapsed backoff makes
+        this forward the half-open probe), transport-vs-4xx
+        classification — a 4xx means the replica is alive and rejected
+        the write, which is transport-level SUCCESS for the breaker (a
+        half-open probe must re-close, not wedge) but is handed to
+        `note_app_error` so the caller surfaces the divergence only
+        after every other owner got its forward — and health recording.
+        Returns the forward's result on success, None otherwise (errors
+        are appended, never raised)."""
+        from .server.client import ClientError
+
+        if not self.health.allow_request(node.id):
+            self.holder.stats.count("WriteForwardSkipped", 1)
+            errors.append(f"{node.id}{what}: unavailable (breaker open)")
+            return None
+        try:
+            res = send(node)
+        except ClientError as e:
+            if not _is_node_failure(e):
+                self.health.record_success(node.id)
+                note_app_error(e)
+                errors.append(f"{node.id}: {e}")
+                return None
+            self.health.record_failure(node.id)
+            self.holder.stats.count("WriteForwardFailed", 1)
+            errors.append(f"{node.id}: {e}")
+            return None
+        self.health.record_success(node.id)
+        return res if res is not None else True
+
     def tolerant_owner_fanout(self, index: str, shard: int, remote: bool,
                               local_fn, forward_fn, on_forward_ok=None):
         """THE write-tolerance policy, shared by PQL writes and bulk
@@ -1092,11 +1126,13 @@ class Executor:
         before surfacing a deterministic 4xx rejection (so one lagging
         replica cannot cause extra divergence on the others), and raise
         only if NO owner applied."""
-        from .server.client import ClientError
-
         applied = 0
         errors = []
-        app_error = None
+        app_error = [None]
+
+        def note(e):
+            app_error[0] = app_error[0] or e
+
         for node in self.cluster.shard_nodes(index, shard):
             if node.id == self.node.id:
                 local_fn()
@@ -1105,40 +1141,107 @@ class Executor:
             if remote:
                 applied += 1  # forwarding node already counted the write
                 continue
-            if not self.health.allow_request(node.id):
-                # Breaker open: don't pay a connect timeout per write.
-                # (When the backoff has elapsed this forward IS the
-                # half-open probe and goes through.)
-                self.holder.stats.count("WriteForwardSkipped", 1)
-                errors.append(f"{node.id}: unavailable (breaker open)")
+            res = self._forward_tolerant(node, forward_fn, errors, note)
+            if res is None:
                 continue
-            try:
-                res = forward_fn(node)
-            except ClientError as e:
-                if not _is_node_failure(e):
-                    # The replica is alive and rejected the write (4xx):
-                    # transport-level success for the breaker (a half-open
-                    # probe must re-close, not wedge), but surface the
-                    # divergence — only after the remaining owners got
-                    # their forward, or one lagging replica would cause
-                    # extra divergence on the others.
-                    self.health.record_success(node.id)
-                    app_error = app_error or e
-                    errors.append(f"{node.id}: {e}")
-                    continue
-                self.health.record_failure(node.id)
-                self.holder.stats.count("WriteForwardFailed", 1)
-                errors.append(f"{node.id}: {e}")
-                continue
-            self.health.record_success(node.id)
             applied += 1
             if on_forward_ok is not None:
-                on_forward_ok(res)
-        if app_error is not None:
-            raise app_error
+                on_forward_ok(res if res is not True else None)
+        if app_error[0] is not None:
+            raise app_error[0]
         if applied == 0:
             raise QueryError(
                 f"write failed on all owners of {index}/shard {shard}: "
+                + "; ".join(errors)
+            )
+
+    def tolerant_group_fanout(self, index: str, shards, remote: bool,
+                              apply_local, send_remote,
+                              workers: int = 1) -> None:
+        """Bulk-import fan-out for MANY shard batches at once: the same
+        write-tolerance policy as tolerant_owner_fanout (dead replicas
+        skipped + marked, deterministic rejections surfaced only after
+        every batch got its chance, failure only when a shard reached NO
+        owner), but parallel — local applies run across the worker pool
+        and remote forwards are batched PER PEER: one task per node
+        streams that node's shard batches sequentially over its
+        keep-alive connection while different nodes (and local applies)
+        proceed concurrently. `workers` caps how much of the shared pool
+        one import may occupy, so a huge load can't starve query fan-out
+        of threads. apply_local(shard) / send_remote(node, shard)."""
+        import threading
+
+        # Placement resolved up front: one routing decision per import.
+        plan = {int(s): self.cluster.shard_nodes(index, int(s)) for s in shards}
+        applied = {s: 0 for s in plan}
+        errors: List[str] = []
+        app_error: List[Optional[Exception]] = [None]
+        mu = threading.Lock()
+
+        local_shards: List[int] = []
+        node_work: Dict[str, tuple] = {}  # node.id -> (node, [shards])
+        for shard, owners in plan.items():
+            for node in owners:
+                if node.id == self.node.id:
+                    local_shards.append(shard)
+                elif remote:
+                    applied[shard] += 1  # forwarding node counted the write
+                else:
+                    node_work.setdefault(node.id, (node, []))[1].append(shard)
+
+        def run_local(shard):
+            try:
+                apply_local(shard)
+            except Exception as e:
+                # Local failures are deterministic (validation, storage
+                # fault): surface after the loop like a replica's 4xx, so
+                # one bad batch can't abort the others mid-flight.
+                with mu:
+                    app_error[0] = app_error[0] or e
+                    errors.append(f"local/shard {shard}: {e}")
+                return
+            with mu:
+                applied[shard] += 1
+
+        def note_app_error(e):
+            with mu:
+                app_error[0] = app_error[0] or e
+
+        def run_node(node, shard_list):
+            # The per-target tolerance step is _forward_tolerant — the
+            # SAME implementation tolerant_owner_fanout uses, so the two
+            # fan-outs cannot drift apart on breaker/4xx semantics.
+            for shard in shard_list:
+                local_errs: List[str] = []
+                res = self._forward_tolerant(
+                    node, lambda n, s=shard: send_remote(n, s),
+                    local_errs, note_app_error, what=f"/shard {shard}")
+                with mu:
+                    errors.extend(local_errs)
+                    if res is not None:
+                        applied[shard] += 1
+
+        tasks = [(run_local, (s,)) for s in local_shards]
+        tasks += [(run_node, nw) for nw in node_work.values()]
+        if self._pool is None or workers <= 1 or len(tasks) <= 1:
+            for fn, args in tasks:
+                fn(*args)
+        else:
+            # Bounded waves rather than one submit-all: `workers` caps
+            # this import's occupancy of the shared pool.
+            cap = max(1, workers)
+            for i in range(0, len(tasks), cap):
+                futs = [self._pool.submit(fn, *args)
+                        for fn, args in tasks[i:i + cap]]
+                for f in futs:
+                    f.result()  # worker exceptions were captured inside
+
+        if app_error[0] is not None:
+            raise app_error[0]
+        failed = sorted(s for s, n in applied.items() if n == 0)
+        if failed:
+            raise QueryError(
+                f"import failed on all owners of {index}/shards {failed}: "
                 + "; ".join(errors)
             )
 

@@ -8,6 +8,7 @@ and common methods are allowed.
 
 from __future__ import annotations
 
+import threading
 from datetime import datetime
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -53,6 +54,23 @@ _COMMON_METHODS = {
 class API:
     def __init__(self, server):
         self.server = server
+        # Ingest observability (/debug/vars `ingest` group): shard batches
+        # applied or routed through this node's import surface.
+        self.import_batches = 0
+        self._import_mu = threading.Lock()
+
+    def _note_import_batches(self, n: int = 1) -> None:
+        with self._import_mu:
+            self.import_batches += n
+
+    @property
+    def ingest_config(self):
+        cfg = getattr(self.server, "ingest_config", None)
+        if cfg is None:
+            from ..ingest import IngestConfig
+
+            cfg = IngestConfig()
+        return cfg
 
     @property
     def holder(self):
@@ -258,9 +276,32 @@ class API:
                 if not fld.keys():
                     raise QueryError("row keys require field 'keys' option")
                 row_ids = store.translate_rows_to_uint64(index, field, list(row_keys))
-            # Re-group by shard now that column ids are known.
-            for sh, cols, (rows, ts) in _by_shard(column_ids, row_ids, timestamps):
-                self.import_bits(index, field, sh, rows, cols, ts, remote=remote)
+            # Re-group by shard now that column ids are known, then fan
+            # the shard batches out across the executor worker pool (one
+            # forward stream per peer) instead of the old serial loop.
+            groups = {
+                sh: (rows, cols, ts)
+                for sh, cols, (rows, ts) in _by_shard(
+                    column_ids, row_ids, timestamps)
+            }
+
+            def apply_local(shard):
+                rows, cols, ts = groups[shard]
+                tsl = None
+                if ts is not None and any(t is not None for t in ts):
+                    tsl = [_to_datetime(t) for t in ts]
+                fld.import_bits(rows, cols, tsl)
+
+            def send(node, shard):
+                rows, cols, ts = groups[shard]
+                self.server.client.import_node(
+                    node, index, field, shard, rows, cols, ts)
+
+            self.executor.tolerant_group_fanout(
+                index, list(groups), remote, apply_local, send,
+                workers=self.ingest_config.import_workers,
+            )
+            self._note_import_batches(len(groups))
             return
 
         n = len(column_ids or [])
@@ -274,10 +315,13 @@ class API:
             )
         def apply_local():
             ts = None
-            if timestamps is not None and any(t for t in timestamps):
+            # Presence = "any entry is not None": a truthiness check here
+            # silently dropped an explicit epoch-0 timestamp.
+            if timestamps is not None and any(t is not None for t in timestamps):
                 ts = [_to_datetime(t) for t in timestamps]
             fld.import_bits(row_ids, column_ids, ts)
 
+        self._note_import_batches()
         self._fan_out_import(
             index, shard, apply_local,
             lambda node: self.server.client.import_node(
@@ -312,14 +356,25 @@ class API:
                 )
                 return
             column_ids = store.translate_columns_to_uint64(index, list(column_keys))
-            for sh, cols, (vals,) in _by_shard(column_ids, values):
-                self.import_values(index, field, sh, cols, vals, remote=remote)
+            groups = {
+                sh: (cols, vals)
+                for sh, cols, (vals,) in _by_shard(column_ids, values)
+            }
+            self.executor.tolerant_group_fanout(
+                index, list(groups), remote,
+                lambda shard: fld.import_value(*groups[shard]),
+                lambda node, shard: self.server.client.import_value_node(
+                    node, index, field, shard, *groups[shard]),
+                workers=self.ingest_config.import_workers,
+            )
+            self._note_import_batches(len(groups))
             return
         if len(column_ids or []) != len(values or []):
             raise QueryError(
                 f"import columns/values length mismatch: "
                 f"{len(column_ids or [])} vs {len(values or [])}"
             )
+        self._note_import_batches()
         self._fan_out_import(
             index, shard, lambda: fld.import_value(column_ids, values),
             lambda node: self.server.client.import_value_node(
@@ -488,8 +543,11 @@ class API:
 
 def _to_datetime(t):
     """Timestamp from wire: RFC3339-minute string (JSON) or epoch
-    nanoseconds (protobuf ImportRequest.Timestamps)."""
-    if t is None or t == 0:
+    nanoseconds (protobuf ImportRequest.Timestamps). Only None means
+    "absent": an explicit epoch-0 is a real timestamp (the protobuf
+    boundary, which cannot distinguish absent from 0, already maps its
+    zeros to None at decode — proto/__init__.py)."""
+    if t is None:
         return None
     if isinstance(t, str):
         return datetime.strptime(t, "%Y-%m-%dT%H:%M")

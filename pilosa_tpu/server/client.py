@@ -397,6 +397,7 @@ class InternalClient:
             row, col = bit[0], bit[1]
             ts = bit[2] if len(bit) > 2 else None
             by_shard.setdefault(col // SHARD_WIDTH, []).append((row, col, ts))
+        by_node: Dict[str, List] = {}
         for shard, group in sorted(by_shard.items()):
             nodes = self.fragment_nodes(host, index, shard)
             target = nodes[0]["uri"] if nodes else host
@@ -406,7 +407,8 @@ class InternalClient:
                 "columnIDs": [b[1] for b in group],
                 "timestamps": [b[2] for b in group],
             }).encode()
-            self._request("POST", f"{_node_url(target)}/index/{index}/field/{field}/import", body)
+            by_node.setdefault(target, []).append(body)
+        self._send_import_groups(index, field, by_node)
 
     def import_values(self, host, index: str, field: str, field_values) -> None:
         from ..constants import SHARD_WIDTH
@@ -422,6 +424,7 @@ class InternalClient:
         by_shard: Dict[int, List] = {}
         for col, val in field_values:
             by_shard.setdefault(col // SHARD_WIDTH, []).append((col, val))
+        by_node: Dict[str, List] = {}
         for shard, group in sorted(by_shard.items()):
             nodes = self.fragment_nodes(host, index, shard)
             target = nodes[0]["uri"] if nodes else host
@@ -430,7 +433,41 @@ class InternalClient:
                 "columnIDs": [g[0] for g in group],
                 "values": [g[1] for g in group],
             }).encode()
-            self._request("POST", f"{_node_url(target)}/index/{index}/field/{field}/import", body)
+            by_node.setdefault(target, []).append(body)
+        self._send_import_groups(index, field, by_node)
+
+    def _send_import_groups(self, index: str, field: str,
+                            by_node: Dict[str, List]) -> None:
+        """POST pre-encoded shard import bodies, nodes in PARALLEL and a
+        node's batches in order: each worker thread owns its per-thread
+        keep-alive pool, so a multi-node bulk load streams every target
+        concurrently instead of serializing the whole import behind one
+        node's round trips. Every node is attempted; the first error is
+        raised after all sends complete (partial progress is repaired by
+        anti-entropy, exactly like the server-side tolerant fan-out)."""
+        def run(target, bodies):
+            for body in bodies:
+                self._request(
+                    "POST",
+                    f"{_node_url(target)}/index/{index}/field/{field}/import",
+                    body)
+
+        if len(by_node) <= 1:
+            for target, bodies in by_node.items():
+                run(target, bodies)
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        first_error = None
+        with ThreadPoolExecutor(max_workers=min(len(by_node), 8)) as pool:
+            futs = [pool.submit(run, t, b) for t, b in by_node.items()]
+            for f in futs:
+                try:
+                    f.result()
+                except Exception as e:
+                    first_error = first_error or e
+        if first_error is not None:
+            raise first_error
 
     # ------------------------------------------------------------- internal
 

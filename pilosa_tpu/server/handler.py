@@ -594,6 +594,15 @@ class Handler:
             ],
             "quarantined_reads": getattr(executor, "quarantined_reads", 0),
         }
+        # Ingest health (docs/ingest.md): un-snapshotted WAL bytes across
+        # fragments, background-snapshot counters and queue depth, and how
+        # many shard batches the import surface has applied/routed — the
+        # on-call question under heavy ingest is "are snapshots keeping up
+        # with the write rate" (wal_bytes climbing without bound means no).
+        ingest = self.api.holder.ingest_stats() if hasattr(
+            self.api.holder, "ingest_stats") else {}
+        ingest["import_batches"] = getattr(self.api, "import_batches", 0)
+        out["ingest"] = ingest
         # Peer fault-tolerance health: per-peer breaker states plus the
         # breaker/retry/hedge counters — the evidence for "a blackholed
         # peer costs zero connect attempts between half-open probes" and

@@ -61,6 +61,7 @@ class Server:
         internal_key_path: Optional[str] = None,
         scheduler_config=None,
         storage_config=None,
+        ingest_config=None,
         engine_config=None,
         join_addr: Optional[str] = None,
         allowed_origins: Optional[List[str]] = None,
@@ -150,6 +151,10 @@ class Server:
             timeout=member_probe_timeout, skip_verify=tls_skip_verify,
             key=self.internal_key,
         )
+        # [ingest] knobs consumed by the API's parallel import fan-out.
+        from ..ingest import IngestConfig
+
+        self.ingest_config = (ingest_config or IngestConfig()).validate()
         self.executor = Executor(
             self.holder,
             cluster=self.cluster,

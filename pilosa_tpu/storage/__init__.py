@@ -32,6 +32,23 @@ class StorageConfig:
 
     fsync: str = FSYNC_BATCH
     fsync_batch_ops: int = 64
+    # Snapshot trigger policy (amortized ingest): rewrite a fragment's
+    # storage file when its op-log bytes exceed snapshot_ratio x the
+    # container-section bytes of the last snapshot (floored at
+    # SNAPSHOT_MIN_BASE so a fresh fragment doesn't snapshot per batch).
+    # Each rewrite grows the base geometrically, so total snapshot I/O
+    # stays O(data ingested / ratio) — write cost proportional to the
+    # batch, not the fragment. 0 disables the byte trigger (op-count and
+    # explicit flushes still apply).
+    snapshot_ratio: float = 0.5
+    # Background sweep cadence (seconds): fragments carrying ANY un-
+    # snapshotted WAL bytes older than this get snapshotted even below
+    # the ratio, bounding replay time after a crash. 0 disables the sweep.
+    snapshot_interval: float = 300.0
+
+    # Ratio-trigger floor (bytes): below this base size the byte trigger
+    # compares against the floor, not the (tiny) file.
+    SNAPSHOT_MIN_BASE = 1 << 20
 
     def validate(self) -> "StorageConfig":
         if self.fsync not in FSYNC_MODES:
@@ -40,4 +57,8 @@ class StorageConfig:
             )
         if self.fsync_batch_ops < 1:
             raise ValueError("storage.fsync-batch-ops must be >= 1")
+        if self.snapshot_ratio < 0:
+            raise ValueError("storage.snapshot-ratio must be >= 0")
+        if self.snapshot_interval < 0:
+            raise ValueError("storage.snapshot-interval must be >= 0")
         return self

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import io
 import struct
+import zlib
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -49,6 +50,20 @@ RUN_MAX_SIZE = 2048
 OP_ADD = 0
 OP_REMOVE = 1
 OP_SIZE = 1 + 8 + 4
+
+# Bulk WAL record: one append per import batch instead of a snapshot —
+# the record that makes ingest cost O(batch) instead of O(fragment).
+# Layout: <B typ> <I n_add> <I n_remove> adds(<u8 * n_add)
+# removes(<u8 * n_remove) <I crc32-of-preceding>. One record covers
+# bulk-set (n_remove=0), bulk-clear (n_add=0), and BSI imports (both:
+# per-plane on/off positions are disjoint, so replay order within the
+# record doesn't matter) — replay is atomic per record, exactly like the
+# 13-byte point ops. Checksum is zlib.crc32, not fnv32a: the fnv loop is
+# pure Python and would cost more than the import it protects on a
+# megabyte record.
+OP_BULK = 2
+_BULK_HEADER = struct.Struct("<BII")
+BULK_MIN_SIZE = _BULK_HEADER.size + 4
 
 _WORD_ONE = np.uint64(1)
 
@@ -91,6 +106,24 @@ def _in_bits(words: np.ndarray, arr: np.ndarray) -> np.ndarray:
     return (words[idx >> 6] >> (idx & np.uint32(63)).astype(np.uint64)) & _WORD_ONE != 0
 
 
+
+
+def _merge_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Union of two sorted unique uint16 arrays in O(n + m log n):
+    searchsorted + one vectorized insert (memmove), replacing union1d's
+    concatenate-and-full-sort — the dominant cost of small incremental
+    batches landing on populated containers."""
+    if not len(a):
+        return np.ascontiguousarray(b, dtype=np.uint16)
+    if not len(b):
+        return a
+    idx = np.searchsorted(a, b)
+    hit = idx < len(a)
+    hit[hit] = a[idx[hit]] == b[hit]
+    new = b[~hit]
+    if not len(new):
+        return a
+    return np.insert(a, idx[~hit], new)
 
 
 def _runs_of_array(c: np.ndarray) -> np.ndarray:
@@ -344,10 +377,19 @@ class Container:
             bits |= _arr_to_words(chunk)
             self.n = _popcount(bits)
         else:
-            self.arr = np.union1d(self.arr, chunk)
+            self.arr = _merge_sorted(self.arr, chunk)
             self.n = len(self.arr)
             self._maybe_densify()
-        self._maybe_runify()
+        # Re-compression probe only when the chunk rewrote a meaningful
+        # fraction of the container: the probe is O(n) (a run walk /
+        # popcount pass), and small incremental batches used to pay it on
+        # EVERY touch just to rediscover that random data never runifies.
+        # Adversarial contiguous imports still compress mid-import —
+        # add_many chunks per container, so a range import lands as one
+        # big chunk — and everything else re-compresses at
+        # optimize()/snapshot time.
+        if 4 * len(chunk) >= self.n:
+            self._maybe_runify()
 
     def remove_sorted(self, chunk: np.ndarray) -> None:
         self.verify_n()
@@ -360,7 +402,8 @@ class Container:
         else:
             self.arr = np.setdiff1d(self.arr, chunk, assume_unique=True)
             self.n = len(self.arr)
-        self._maybe_runify()
+        if 4 * len(chunk) >= self.n:
+            self._maybe_runify()
 
     def _force_densify(self) -> None:
         self.bits = _arr_to_words(self.arr)
@@ -643,7 +686,8 @@ class _ContainerMap(MutableMapping):
 class Bitmap:
     """Two-form-container bitmap over uint64 values."""
 
-    __slots__ = ("containers", "op_n", "_skeys", "valid_len", "truncated_bytes")
+    __slots__ = ("containers", "op_n", "_skeys", "valid_len",
+                 "truncated_bytes", "ops_bytes", "_cow")
 
     def __init__(self, values=None):
         # key (value >> 16) -> Container of low 16 bits
@@ -654,7 +698,15 @@ class Bitmap:
         # it were discarded (0 = the whole buffer parsed clean).
         self.valid_len = 0
         self.truncated_bytes = 0
+        # Bytes of the valid region occupied by op-log records (the rest is
+        # the container section) — seeds the fragment's snapshot-trigger
+        # accounting across a reopen.
+        self.ops_bytes = 0
         self._skeys: Optional[np.ndarray] = None  # sorted key cache
+        # Keys whose containers are shared with a cow_clone() snapshot: the
+        # next mutation of such a container copies it first, so the clone
+        # stays frozen while live writes proceed (background snapshots).
+        self._cow: Optional[set] = None
         if values is not None:
             self.add_many(np.asarray(values, dtype=np.uint64))
 
@@ -676,13 +728,36 @@ class Bitmap:
 
     def _live(self, key) -> Optional[Container]:
         """Container for key, upgraded in place if stored as a raw ndarray
-        (legacy callers/tests) so mutations are not lost."""
+        (legacy callers/tests) so mutations are not lost. The single
+        gateway every mutation path flows through, which is what makes
+        copy-on-write snapshots sound: a container shared with a
+        cow_clone() is copied here before its first post-snapshot
+        mutation."""
         c = self.containers.get(key)
-        if c is None or isinstance(c, Container):
-            return c
-        c = _as_container(c)
-        self.containers[key] = c
+        if c is None:
+            return None
+        if not isinstance(c, Container):
+            c = _as_container(c)
+            self.containers[key] = c
+        if self._cow and key in self._cow:
+            self._cow.discard(key)
+            c = c.copy()
+            self.containers[key] = c
         return c
+
+    def cow_clone(self) -> "Bitmap":
+        """Shallow snapshot sharing Container objects with this bitmap.
+        O(container count), not O(bytes): the handoff a background
+        snapshot takes under a brief mutex hold. After the clone, this
+        (live) bitmap copies any shared container before mutating it, so
+        the clone observes a frozen point-in-time state while writes
+        proceed. The clone itself must be treated as read-only."""
+        b = Bitmap()
+        items = list(self.containers.items())
+        for k, c in items:
+            b.containers[k] = c
+        self._cow = {k for k, _ in items}
+        return b
 
     # ------------------------------------------------------------------ basic
 
@@ -1100,13 +1175,50 @@ class Bitmap:
         # appends only ever tear the final record, so a bad mid-log record
         # is bit rot — raise (quarantine + replica repair) rather than
         # silently truncating away every acknowledged op after it.
+        #
+        # Records are either 13-byte point ops (typ 0/1) or variable-length
+        # bulk records (typ 2). Appends write a whole record in one
+        # flush, so a torn record's PREFIX — including its type byte and,
+        # when present, its length fields — is trustworthy; a bulk record
+        # whose declared size overruns the buffer is therefore a torn
+        # final append (truncate), with one caveat: bit rot inside a
+        # mid-log bulk record's length fields is indistinguishable from
+        # that tear and also truncates (reported via truncated_bytes;
+        # anti-entropy repairs the difference from a replica).
+        op_start = ops_offset
         while ops_offset < len(data):
-            if len(data) - ops_offset < OP_SIZE:
+            remaining = len(data) - ops_offset
+            if data[ops_offset] == OP_BULK:
+                if remaining < BULK_MIN_SIZE:
+                    break  # incomplete trailing record
+                _, n_add, n_rem = _BULK_HEADER.unpack_from(data, ops_offset)
+                size = _BULK_HEADER.size + 8 * (n_add + n_rem) + 4
+                if size > remaining:
+                    break  # torn final append (see caveat above)
+                body_end = ops_offset + size - 4
+                chk = struct.unpack_from("<I", data, body_end)[0]
+                if chk != zlib.crc32(bytes(data[ops_offset:body_end])):
+                    if size < remaining:
+                        raise CorruptFragmentError(
+                            "bulk op checksum failure mid-log (not a torn "
+                            "tail)", offset=ops_offset)
+                    break  # corrupt FINAL record: a torn append
+                off = ops_offset + _BULK_HEADER.size
+                adds = np.frombuffer(data, dtype="<u8", count=n_add,
+                                     offset=off)
+                rems = np.frombuffer(data, dtype="<u8", count=n_rem,
+                                     offset=off + 8 * n_add)
+                b.add_many(adds.astype(np.uint64))
+                b.remove_many(rems.astype(np.uint64))
+                b.op_n += 1
+                ops_offset += size
+                continue
+            if remaining < OP_SIZE:
                 break  # incomplete trailing record
             try:
                 op = parse_op(data, ops_offset)
             except CorruptFragmentError:
-                if len(data) - ops_offset > OP_SIZE:
+                if remaining > OP_SIZE:
                     raise CorruptFragmentError(
                         "op checksum failure mid-log (not a torn tail)",
                         offset=ops_offset,
@@ -1117,6 +1229,7 @@ class Bitmap:
             ops_offset += OP_SIZE
         b.valid_len = ops_offset
         b.truncated_bytes = len(data) - ops_offset
+        b.ops_bytes = ops_offset - op_start
         return b
 
     def apply_op(self, typ: int, value: int) -> bool:
@@ -1134,9 +1247,14 @@ class Bitmap:
     def optimize(self) -> None:
         """Adopt the run form wherever it at least halves a container's
         memory (reference roaring.go Optimize). Called at snapshot time so
-        point-mutation churn between snapshots re-compresses."""
+        point-mutation churn between snapshots re-compresses. Goes through
+        _live: a container shared with a cow_clone() snapshot must be
+        copied before the in-place form change, or the clone's serializer
+        could observe a torn form transition mid-read."""
         for k in list(self.containers):
-            c = _as_container(self.containers[k])
+            c = self._live(k)
+            if c is None:
+                continue
             before = c.runs is None
             c._maybe_runify()
             if before and c.runs is not None:
@@ -1155,6 +1273,18 @@ class Bitmap:
 def encode_op(typ: int, value: int) -> bytes:
     body = struct.pack("<BQ", typ, value)
     return body + struct.pack("<I", fnv32a(body))
+
+
+def encode_bulk_op(adds=None, removes=None) -> bytes:
+    """One WAL record for a whole import batch (see OP_BULK). `adds` and
+    `removes` are uint64 position arrays (either may be None/empty);
+    duplicates are fine (replay add_many/remove_many dedups)."""
+    a = np.ascontiguousarray(
+        adds if adds is not None else (), dtype="<u8")
+    r = np.ascontiguousarray(
+        removes if removes is not None else (), dtype="<u8")
+    body = _BULK_HEADER.pack(OP_BULK, len(a), len(r)) + a.tobytes() + r.tobytes()
+    return body + struct.pack("<I", zlib.crc32(body))
 
 
 def parse_op(data: bytes, offset: int = 0) -> Tuple[int, int]:

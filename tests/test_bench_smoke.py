@@ -6,6 +6,7 @@ breakage only surfaced at measurement time; this test makes a broken
 stanza (or a hung bring-up path) a PR-time failure instead.
 """
 
+import importlib.util
 import json
 import os
 import subprocess
@@ -13,10 +14,16 @@ import sys
 
 BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
 
-STANZAS = (
-    "hbm", "big", "scale", "open", "import", "serving", "sched", "mixed",
-    "fault", "topn_bsi", "time_range",
-)
+
+def _registered_stanzas():
+    """Read the stanza registry from bench.py itself: the guard asserts
+    EVERY registered stanza rides the final JSON line, so a stanza added
+    to bench can never silently fall out of it (sched/mixed each went
+    missing once before this was keyed off the registry)."""
+    spec = importlib.util.spec_from_file_location("_bench_mod", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return tuple(name.lower() for name, _ in mod.STANZAS)
 
 
 def test_bench_smoke_runs_every_stanza(tmp_path):
@@ -50,7 +57,9 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     detail = parsed["detail"]
     assert not detail.get("partial"), detail.get("partial")
     assert parsed["value"] > 0
-    for name in STANZAS:
+    stanzas = _registered_stanzas()
+    assert len(stanzas) >= 11  # the registry itself didn't shrink
+    for name in stanzas:
         stanza = detail.get(name)
         assert isinstance(stanza, dict), f"stanza {name} missing: {stanza}"
         assert "error" not in stanza, f"stanza {name}: {stanza['error']}"
@@ -58,6 +67,12 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     # must move fewer bytes to the device than delta-off.
     mixed = detail["mixed"]
     assert mixed["delta_ok"], mixed
+    # The INGEST stanza is the amortized-ingest acceptance metric:
+    # WAL-amortized bulk imports must beat snapshot-per-batch >= 5x at
+    # smoke scale.
+    ingest = detail["ingest"]
+    assert ingest["amortized_vs_snapshot"] >= 5.0, ingest
+    assert ingest["ingest_ok"], ingest
     # The FAULT stanza is the resilience acceptance metric: the scripted
     # brown-out must end with converged routing and a recovery time.
     fault = detail["fault"]

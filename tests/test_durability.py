@@ -422,6 +422,108 @@ def test_injected_crash_mid_snapshot(tmp_path):
     frag.close()
 
 
+def test_sigkill_mid_bulk_import_preserves_acked_batches(tmp_path):
+    """kill -9 during a stream of WAL-amortized bulk imports: every batch
+    acknowledged before the kill must replay whole after reopen (bulk
+    records flush before the ack; a torn final record truncates)."""
+    path = str(tmp_path / "fragments" / "0")
+    child = _run_child("""
+        import numpy as np
+        frag = Fragment(sys.argv[1], "i", "f", "standard", 0)
+        frag.open()
+        for i in range(10_000):
+            rows = np.full(100, i % 7, dtype=np.uint64)
+            cols = np.arange(i * 100, (i + 1) * 100, dtype=np.uint64) % (1 << 20)
+            frag.bulk_import(rows, cols)
+            print(i, flush=True)  # the ack
+    """, path)
+    acked = -1
+    try:
+        for line in child.stdout:
+            acked = int(line)
+            if acked >= 30:
+                break
+    finally:
+        child.kill()
+        child.wait(timeout=30)
+    assert acked >= 30
+    frag = Fragment(path, "i", "f", "standard", 0)
+    frag.open()
+    for i in range(acked + 1):
+        col = (i * 100) % (1 << 20)
+        assert frag.bit(i % 7, col), f"lost acked batch {i}"
+    frag.close()
+
+
+def test_injected_crash_mid_bulk_append_torn_tail(tmp_path):
+    """Deterministic: crash at the bulk-append boundary, then tear the
+    tail by hand — recovery truncates back to the last whole record."""
+    path = str(tmp_path / "fragments" / "0")
+    child = _run_child("""
+        import numpy as np
+        frag = Fragment(sys.argv[1], "i", "f", "standard", 0)
+        frag.open()
+        frag.bulk_import(np.zeros(50, dtype=np.uint64),
+                         np.arange(50, dtype=np.uint64))
+        print("acked", flush=True)
+        failpoints.configure("bulk-wal-append", "crash")
+        frag.bulk_import(np.ones(50, dtype=np.uint64),
+                         np.arange(50, dtype=np.uint64))
+        print("NEVER", flush=True)
+    """, path)
+    out, err = child.communicate(timeout=120)
+    assert child.returncode == failpoints.CRASH_EXIT_CODE, err
+    assert "acked" in out and "NEVER" not in out
+    from pilosa_tpu.storage.bitmap import encode_bulk_op
+
+    rec = encode_bulk_op(np.arange(10, dtype=np.uint64), None)
+    with open(path, "ab") as fh:
+        fh.write(rec[: len(rec) - 5])  # torn bulk record on top
+    frag = Fragment(path, "i", "f", "standard", 0)
+    frag.open()
+    assert frag.row_count(0) == 50
+    assert frag.row_count(1) == 0  # the crashed batch was never acked
+    assert frag.recovered_tail_bytes == len(rec) - 5
+    frag.close()
+
+
+def test_sigkill_mid_background_snapshot(tmp_path):
+    """Crash at the BACKGROUND snapshot's rename boundary (the crash
+    fires on the snapshotter thread; os._exit models kill -9): the
+    original file with its bulk op log is the durable truth, reopen
+    recovers every acked write and cleans the leftover temp."""
+    data_dir = str(tmp_path / "indexes")
+    child = _run_child("""
+        import numpy as np, time
+        from pilosa_tpu.core.holder import Holder
+        from pilosa_tpu.storage import StorageConfig
+
+        failpoints.configure("snapshot-rename", "crash")
+        h = Holder(sys.argv[1],
+                   storage_config=StorageConfig(snapshot_interval=0))
+        h.open()
+        fld = h.create_index("t").create_field("f")
+        rows = np.repeat(np.arange(4, dtype=np.uint64), 50_000)
+        cols = np.tile(np.arange(50_000, dtype=np.uint64), 4)
+        fld.import_bits(rows, cols)  # 1.6 MB WAL record: policy fires
+        print("acked", flush=True)
+        time.sleep(30)  # the snapshotter thread crashes the process
+        print("NEVER", flush=True)
+    """, data_dir)
+    out, err = child.communicate(timeout=120)
+    assert child.returncode == failpoints.CRASH_EXIT_CODE, err
+    assert "acked" in out and "NEVER" not in out
+    from pilosa_tpu.core.holder import Holder
+
+    h = Holder(data_dir).open()
+    frag = h.fragment("t", "f", "standard", 0)
+    assert not frag.quarantined
+    assert not os.path.exists(frag.path + ".snapshotting.bg")
+    for r in range(4):
+        assert frag.row_count(r) == 50_000, r
+    h.close()
+
+
 # ----------------------------------- quarantine repair via anti-entropy
 
 
