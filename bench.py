@@ -1126,6 +1126,174 @@ def bench_fault():
     return out
 
 
+# ---------------------------------------------------- rebalance stanza
+
+
+def bench_rebalance():
+    """Online elastic rebalance (docs/rebalance.md) vs the legacy
+    stop-the-world resizeJob: a node joins a 2-node serving cluster with
+    data while a reader and a writer keep hammering it. Reports read
+    qps/p99 and write success DURING the migration for both modes, plus
+    time-to-rebalance — the stop-the-world path flips the whole cluster
+    to RESIZING (every API call rejected) while the online path keeps
+    serving on per-shard routing epochs."""
+    import shutil
+    import socket
+    import tempfile
+    import threading
+
+    from pilosa_tpu.cluster.hash import ModHasher
+    from pilosa_tpu.cluster.rebalance import RebalanceConfig
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.errors import PilosaError
+    from pilosa_tpu.server.client import ClientError, InternalClient
+    from pilosa_tpu.server.server import Server
+
+    n_shards = 2 if SMOKE else 4
+    bits_per_shard = 2_000 if SMOKE else 50_000
+    throttle = 0.0  # unthrottled: measure the natural migration window
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def run_mode(online: bool) -> dict:
+        tmp = tempfile.mkdtemp(prefix="bench-rebalance-")
+        ports = [free_port() for _ in range(3)]
+        hosts = [f"localhost:{p}" for p in ports]
+        cfg = RebalanceConfig(online=online, max_bytes_per_sec=throttle)
+        servers = []
+        try:
+            for i in range(2):
+                s = Server(
+                    data_dir=os.path.join(tmp, f"node{i}"),
+                    port=ports[i],
+                    cluster_hosts=hosts[:2],
+                    hasher=ModHasher(),
+                    cache_flush_interval=0,
+                    anti_entropy_interval=0,
+                    member_monitor_interval=0,
+                    rebalance_config=cfg,
+                )
+                s.open()
+                servers.append(s)
+            client = InternalClient(timeout=10.0)
+            h0 = servers[0].node.uri
+            client.create_index(h0, "rb")
+            client.create_field(h0, "rb", "f")
+            time.sleep(0.05)
+            # Dense base injected directly (the base is scenery): real
+            # migration bytes, not a toy handful of bits.
+            rng = np.random.default_rng(11)
+            for s in servers:
+                for shard in range(n_shards):
+                    frag = None
+                    if any(n.id == s.node.id
+                           for n in s.cluster.shard_nodes("rb", shard)):
+                        fld = s.holder.field("rb", "f")
+                        view = fld.create_view_if_not_exists("standard")
+                        frag = view.create_fragment_if_not_exists(
+                            shard, broadcast=False)
+                    if frag is not None:
+                        cols = rng.choice(SHARD_WIDTH, size=bits_per_shard,
+                                          replace=False).astype(np.uint64)
+                        frag.bulk_import(
+                            np.ones(bits_per_shard, dtype=np.uint64), cols)
+                    idx = s.holder.index("rb")
+                    idx.set_remote_max_shard(n_shards - 1)
+
+            stop = threading.Event()
+            lat: list = []
+            counters = {"read_ok": 0, "read_err": 0,
+                        "write_ok": 0, "write_err": 0}
+            rc = InternalClient(timeout=10.0)
+            wc = InternalClient(timeout=10.0)
+
+            def reader():
+                while not stop.is_set():
+                    q0 = time.perf_counter()
+                    try:
+                        rc.query(h0, "rb", "Count(Row(f=1))")
+                        counters["read_ok"] += 1
+                        lat.append(time.perf_counter() - q0)
+                    except (ClientError, PilosaError):
+                        counters["read_err"] += 1
+                    time.sleep(0.001)
+
+            def writer():
+                col = 0
+                while not stop.is_set():
+                    target = (col % n_shards) * SHARD_WIDTH + (col % 1000)
+                    try:
+                        wc.query(h0, "rb", f"Set({target}, f=2)")
+                        counters["write_ok"] += 1
+                    except (ClientError, PilosaError):
+                        counters["write_err"] += 1
+                    col += 1
+                    time.sleep(0.002)
+
+            threads = [threading.Thread(target=reader, daemon=True),
+                       threading.Thread(target=writer, daemon=True)]
+            for t in threads:
+                t.start()
+            time.sleep(0.1)
+
+            t0 = time.perf_counter()
+            s2 = Server(
+                data_dir=os.path.join(tmp, "node2"),
+                port=ports[2], join_addr=h0, is_coordinator=False,
+                hasher=ModHasher(), cache_flush_interval=0,
+                anti_entropy_interval=0, member_monitor_interval=0,
+                rebalance_config=cfg,
+            )
+            s2.open()
+            servers.append(s2)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if (len(servers[0].cluster.nodes) == 3
+                        and servers[0].cluster.state == "NORMAL"
+                        and servers[0].cluster.next_nodes is None):
+                    break
+                time.sleep(0.01)
+            dt = time.perf_counter() - t0
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+            lat.sort()
+            pick = (lambda q: round(
+                lat[min(len(lat) - 1, int(len(lat) * q))] * 1e3, 2
+            )) if lat else (lambda q: None)
+            return {
+                "time_to_rebalance_s": round(dt, 3),
+                "read_qps": round(counters["read_ok"] / dt, 1) if dt else 0.0,
+                "read_p50_ms": pick(0.50), "read_p99_ms": pick(0.99),
+                "read_errors": counters["read_err"],
+                "write_ok": counters["write_ok"],
+                "write_errors": counters["write_err"],
+            }
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    out = {"shards": n_shards, "bits_per_shard": bits_per_shard}
+    out["online"] = run_mode(True)
+    out["stop_the_world"] = run_mode(False)
+    # The stanza's pass condition: the online path kept serving (reads
+    # succeeded during the migration) and the job completed.
+    out["rebalance_ok"] = bool(
+        out["online"]["read_qps"] > 0
+        and out["online"]["time_to_rebalance_s"] < 120
+    )
+    return out
+
+
 # ------------------------------------------------------- ingest stanza
 
 
@@ -1614,6 +1782,7 @@ STANZAS = (
     ("SCHED", bench_sched),
     ("MIXED", bench_mixed),
     ("FAULT", bench_fault),
+    ("REBALANCE", bench_rebalance),
     ("TOPN_BSI", bench_topn_bsi),
     ("TIME_RANGE", bench_time_range),
 )

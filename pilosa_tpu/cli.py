@@ -83,6 +83,38 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                         "requests (0 disables hedging)")
     p.add_argument("--resilience-hedge-min-delay",
                    dest="resilience_hedge_min_delay", type=float)
+    p.add_argument("--rebalance-online", dest="rebalance_online",
+                   type=lambda s: s.lower() in ("1", "true", "yes"),
+                   metavar="{true,false}",
+                   help="live shard migration with routing epochs (default "
+                        "true); false restores the legacy stop-the-world "
+                        "resize")
+    p.add_argument("--rebalance-max-concurrent-streams",
+                   dest="rebalance_max_concurrent_streams", type=int,
+                   help="concurrent per-shard migration streams one "
+                        "receiving node runs")
+    p.add_argument("--rebalance-max-bytes-per-sec",
+                   dest="rebalance_max_bytes_per_sec", type=float,
+                   help="receiver-side migration throughput cap in bytes/s "
+                        "(0 = unthrottled)")
+    p.add_argument("--rebalance-catchup-threshold-bytes",
+                   dest="rebalance_catchup_threshold_bytes", type=int,
+                   help="WAL-tail bytes per catch-up round under which a "
+                        "migrating shard is ready for cutover")
+    p.add_argument("--rebalance-max-catchup-rounds",
+                   dest="rebalance_max_catchup_rounds", type=int,
+                   help="catch-up rounds before a migrating shard declares "
+                        "ready regardless")
+    p.add_argument("--rebalance-cutover-pause-max",
+                   dest="rebalance_cutover_pause_max", type=float,
+                   help="seconds a write caught in a cutover window "
+                        "re-routes/waits for the commit before failing "
+                        "clean")
+    p.add_argument("--rebalance-follower-timeout",
+                   dest="rebalance_follower_timeout", type=float,
+                   help="seconds a follower stays RESIZING before probing "
+                        "the coordinator and reverting to NORMAL (legacy "
+                        "resize watchdog)")
     p.add_argument("--sched-max-queue", dest="sched_max_queue", type=int,
                    help="bounded admission queue; full requests get 429")
     p.add_argument("--sched-interactive-concurrency",

@@ -117,6 +117,13 @@ class HealthRegistry:
     """Thread-safe per-peer breaker/budget/latency state for one node's
     view of its cluster. `clock` is injectable for deterministic tests."""
 
+    # A peer under migration copy load (cluster/rebalance.py participants)
+    # gets this multiplier on breaker_failures before its breaker opens —
+    # slow responses while streaming gigabytes are expected load, not
+    # death, and marking a joining node dead mid-copy aborts the join.
+    COPY_GRACE_MULT = 4
+    COPY_GRACE_TTL = 600.0
+
     def __init__(self, config: Optional[ResilienceConfig] = None,
                  clock: Optional[Callable[[], float]] = None):
         import time
@@ -125,6 +132,10 @@ class HealthRegistry:
         self.clock = clock or time.monotonic
         self._mu = threading.Lock()
         self._peers: Dict[str, _Peer] = {}
+        # node id -> grace deadline (clock units). Set by the rebalance
+        # coordinator's begin broadcast, cleared at complete/abort; the
+        # TTL bounds a lost clear.
+        self._copy_grace: Dict[str, float] = {}
         # Retry token bucket (one bucket per node, not per peer: the thing
         # being protected is the SURVIVORS' aggregate load).
         self._retry_tokens = float(self.config.retry_budget)
@@ -229,19 +240,53 @@ class HealthRegistry:
                 self._retry_tokens = min(
                     cap, self._retry_tokens + self.config.retry_refill)
 
+    def set_copy_grace(self, node_id: str,
+                       ttl: Optional[float] = None) -> None:
+        """Mark a peer as a live-migration participant: its breaker needs
+        COPY_GRACE_MULT x the usual consecutive failures to open, and the
+        member monitor damps its probe threshold the same way."""
+        with self._mu:
+            self._copy_grace[node_id] = self.clock() + (
+                ttl if ttl is not None else self.COPY_GRACE_TTL)
+
+    def clear_copy_grace(self, node_id: Optional[str] = None) -> None:
+        with self._mu:
+            if node_id is None:
+                self._copy_grace.clear()
+            else:
+                self._copy_grace.pop(node_id, None)
+
+    def in_copy_grace(self, node_id: str) -> bool:
+        with self._mu:
+            return self._grace_active(node_id)
+
+    def _grace_active(self, node_id: str) -> bool:
+        # Must hold _mu.
+        deadline = self._copy_grace.get(node_id)
+        if deadline is None:
+            return False
+        if self.clock() > deadline:
+            del self._copy_grace[node_id]
+            return False
+        return True
+
     def record_failure(self, node_id: str) -> None:
         """A transport-level failure (connect/5xx/corrupt body) talking to
         the peer: advance the breaker. A failed half-open probe re-opens
         with doubled backoff; `breaker_failures` consecutive failures open
-        a closed breaker."""
+        a closed breaker (scaled up while the peer is under migration
+        copy-load grace)."""
         now = self.clock()
         with self._mu:
             p = self._peer(node_id)
             p.consec_failures += 1
+            threshold = self.config.breaker_failures
+            if self._grace_active(node_id):
+                threshold *= self.COPY_GRACE_MULT
             if p.state == HALF_OPEN:
                 self._reopen(p, now)
             elif p.state == CLOSED and (
-                p.consec_failures >= self.config.breaker_failures
+                p.consec_failures >= threshold
             ):
                 p.state = OPEN
                 p.opened_at = now
@@ -295,6 +340,7 @@ class HealthRegistry:
         same id starts with a clean slate."""
         with self._mu:
             self._peers.pop(node_id, None)
+            self._copy_grace.pop(node_id, None)
 
     def prune_absent(self, live_ids) -> None:
         """Drop state for peers no longer in the membership (wholesale
@@ -303,6 +349,8 @@ class HealthRegistry:
         with self._mu:
             for nid in [n for n in self._peers if n not in live]:
                 del self._peers[nid]
+            for nid in [n for n in self._copy_grace if n not in live]:
+                del self._copy_grace[nid]
 
     # -------------------------------------------------------- retry budget
 
@@ -380,10 +428,14 @@ class HealthRegistry:
                     "openCount": p.open_count,
                     "latencySamples": len(p.latencies),
                 }
+            now = self.clock()
             return {
                 "peers": peers,
                 "retryTokens": round(self._retry_tokens, 2)
                 if self.config.retry_budget else None,
+                "copyGracePeers": sorted(
+                    nid for nid, dl in self._copy_grace.items() if now <= dl
+                ),
                 **dict(self.counters),
             }
 

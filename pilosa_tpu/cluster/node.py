@@ -8,8 +8,9 @@ the executor can use placement without pulling in networking.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import List, Optional, Set, Tuple
 
 from ..constants import DEFAULT_PARTITION_N
 from .hash import JmpHasher, partition as partition_of
@@ -76,23 +77,118 @@ class Cluster:
         # memberlist suspicion state). A set-like view over the breaker
         # state: `in` means "breaker not closed", add/discard force it.
         self.unavailable = DownView(self.health)
+        # Per-shard routing epochs (cluster/rebalance.py). During a live
+        # rebalance `next_nodes` holds the target membership and
+        # `migrated` the (index, shard) pairs whose cutover committed:
+        # placement for a migrated shard follows the NEXT topology while
+        # every other shard stays on the old owners — a half-migrated
+        # cluster never serves a hole. `routing_epoch` is monotonic;
+        # forwarded requests stamp it, and a receiver that has advanced
+        # past the sender's epoch answers 409 (one re-route) instead of
+        # serving from a moved/GC'd shard.
+        self.routing_epoch = 0
+        self.next_nodes: Optional[List[Node]] = None
+        self.migrated: Set[Tuple[str, int]] = set()
+        self._routing_mu = threading.Lock()
 
     # ------------------------------------------------------------ placement
 
     def partition(self, index: str, shard: int) -> int:
         return partition_of(index, shard, self.partition_n)
 
-    def partition_nodes(self, partition_id: int) -> List[Node]:
-        if not self.nodes:
+    def _placement(self, nodes: List[Node], partition_id: int) -> List[Node]:
+        if not nodes:
             return []
-        replica_n = min(self.replica_n, len(self.nodes)) or 1
-        node_index = self.hasher.hash(partition_id, len(self.nodes))
-        return [
-            self.nodes[(node_index + i) % len(self.nodes)] for i in range(replica_n)
-        ]
+        replica_n = min(self.replica_n, len(nodes)) or 1
+        node_index = self.hasher.hash(partition_id, len(nodes))
+        return [nodes[(node_index + i) % len(nodes)] for i in range(replica_n)]
+
+    def partition_nodes(self, partition_id: int) -> List[Node]:
+        return self._placement(self.nodes, partition_id)
 
     def shard_nodes(self, index: str, shard: int) -> List[Node]:
-        return self.partition_nodes(self.partition(index, shard))
+        # Snapshot the override state once: a concurrent commit/abort can
+        # null next_nodes between a check and a re-read, and
+        # _placement(None) would return zero owners for an owned shard.
+        nxt = self.next_nodes
+        nodes = self.nodes
+        if nxt is not None and (index, shard) in self.migrated:
+            nodes = nxt
+        return self._placement(nodes, self.partition(index, shard))
+
+    # ------------------------------------------------------ routing epochs
+
+    def _advance_epoch(self, epoch: Optional[int]) -> None:
+        # Must hold _routing_mu. An epoch carried by a coordinator
+        # message is AUTHORITATIVE: merge with max() only. A local
+        # routing change with no message epoch bumps by one. Doing both
+        # (max(local+1, msg)) overshoots under message reordering — a
+        # later commit's merge jumps the counter, then an earlier
+        # commit's +1 pushes it past every number the coordinator will
+        # ever send, and the node ends permanently ahead of the cluster.
+        if epoch is not None:
+            self.routing_epoch = max(self.routing_epoch, epoch)
+        else:
+            self.routing_epoch += 1
+
+    def begin_rebalance(self, new_nodes: List[Node], committed=(),
+                        epoch: Optional[int] = None) -> None:
+        """Install the target membership of a live rebalance. Placement
+        keeps following the OLD nodes until per-shard cutovers commit."""
+        with self._routing_mu:
+            self.next_nodes = sorted(new_nodes, key=lambda n: n.id)
+            self.migrated = {(i, int(s)) for i, s in committed}
+            self._advance_epoch(epoch)
+
+    def apply_cutover(self, index: str, shard: int,
+                      epoch: Optional[int] = None) -> None:
+        """Commit one shard's routing flip to the next topology."""
+        with self._routing_mu:
+            if self.next_nodes is None:
+                # No rebalance in flight (late/duplicate commit); still
+                # merge an authoritative epoch so a node that already
+                # collapsed the overrides doesn't fall behind.
+                if epoch is not None:
+                    self.routing_epoch = max(self.routing_epoch, epoch)
+                return
+            if (index, shard) in self.migrated:
+                # Idempotent: the source flips at freeze time and again on
+                # the broadcast commit; only the first advances the epoch.
+                if epoch is not None:
+                    self.routing_epoch = max(self.routing_epoch, epoch)
+                return
+            self.migrated.add((index, shard))
+            self._advance_epoch(epoch)
+
+    def commit_topology(self, new_nodes: Optional[List[Node]] = None,
+                        epoch: Optional[int] = None) -> None:
+        """Job completion: the target membership becomes THE membership
+        and the per-shard overrides collapse."""
+        with self._routing_mu:
+            nodes = new_nodes if new_nodes is not None else self.next_nodes
+            if nodes is not None:
+                self.nodes = sorted(nodes, key=lambda n: n.id)
+            self.next_nodes = None
+            self.migrated = set()
+            self._advance_epoch(epoch)
+
+    def abort_rebalance(self, committed=None) -> bool:
+        """Drop a live rebalance. Returns True when routing fully
+        reverted to the old topology; False when cutovers had already
+        committed — those shards keep the mixed routing (their data now
+        lives on the new owners; reverting would lose post-cutover
+        writes) until a resumed job finishes the move."""
+        with self._routing_mu:
+            kept = {(i, int(s)) for i, s in committed} if committed else set()
+            kept &= self.migrated
+            if not kept:
+                self.next_nodes = None
+                self.migrated = set()
+                self.routing_epoch += 1
+                return True
+            self.migrated = kept
+            self.routing_epoch += 1
+            return False
 
     def mark_unavailable(self, node_id: str) -> None:
         self.unavailable.add(node_id)
@@ -114,6 +210,12 @@ class Cluster:
         for n in self.nodes:
             if n.id == node_id:
                 return n
+        # Mid-rebalance, a cut-over shard's owners come from the target
+        # membership (e.g. the joining node) before it appears in `nodes`.
+        if self.next_nodes is not None:
+            for n in self.next_nodes:
+                if n.id == node_id:
+                    return n
         return None
 
     def coordinator_node(self) -> Optional[Node]:

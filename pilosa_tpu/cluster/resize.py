@@ -19,26 +19,41 @@ from ..errors import PilosaError
 
 
 def fragment_sources(
-    old_cluster: Cluster, new_cluster: Cluster, schema: List[dict], max_shards: Dict[str, int]
+    old_cluster: Cluster, new_cluster: Cluster, schema: List[dict],
+    max_shards: Dict[str, int], source_ok=None,
 ) -> Dict[str, List[dict]]:
     """Per-node list of fragments each node must fetch, with a source node
-    owning that fragment in the old placement (cluster.go:689 fragSources)."""
+    owning that fragment in the old placement (cluster.go:689 fragSources).
+
+    `source_ok(node_id, index, field, view, shard) -> bool` lets the
+    caller steer source selection away from unhealthy replicas: the
+    first old owner it accepts wins, falling back to placement order if
+    it rejects them all (a degraded source beats no source — the fetch
+    itself still fails loudly if the source refuses). Shards with NO old
+    owner (an empty prior cluster) are skipped outright: there is
+    nothing to fetch, and blindly indexing old_owners[0] raised."""
     sources: Dict[str, List[dict]] = {n.id: [] for n in new_cluster.nodes}
-    old_ids = {n.id for n in old_cluster.nodes}
     for idx_info in schema:
         index = idx_info["name"]
         max_shard = max_shards.get(index, 0)
         for shard in range(max_shard + 1):
             old_owners = [n.id for n in old_cluster.shard_nodes(index, shard)]
+            if not old_owners:
+                continue
             new_owners = [n.id for n in new_cluster.shard_nodes(index, shard)]
-            for node_id in new_owners:
-                if node_id in old_owners or node_id not in old_ids and node_id not in sources:
-                    continue
-                if node_id not in sources:
-                    continue
-                src = old_owners[0]
-                for f_info in idx_info.get("fields", []):
-                    for v_info in f_info.get("views", []):
+            gaining = [nid for nid in new_owners if nid not in old_owners]
+            if not gaining:
+                continue
+            for f_info in idx_info.get("fields", []):
+                for v_info in f_info.get("views", []):
+                    src = old_owners[0]
+                    if source_ok is not None:
+                        for cand in old_owners:
+                            if source_ok(cand, index, f_info["name"],
+                                         v_info["name"], shard):
+                                src = cand
+                                break
+                    for node_id in gaining:
                         sources[node_id].append(
                             {
                                 "index": index,
@@ -191,10 +206,24 @@ class ResizeCoordinator:
                     "nodes": [n.to_dict() for n in job.new_nodes],
                 }
             )
+            # Post-resize GC on the COORDINATOR too: followers run the
+            # holder cleaner on their RESIZING -> NORMAL status
+            # transition, but the coordinator never receives its own
+            # broadcast — without this it kept every fragment it stopped
+            # owning, forever.
+            from .topology import HolderCleaner
+
+            removed = HolderCleaner(self.server).clean_holder()
+            if removed:
+                self.server.logger.info(
+                    "resize %s: holder cleaner removed %d fragments",
+                    job.id, len(removed))
 
 
 def follow_resize_instruction(server, msg: dict) -> None:
     """Receiver side (cluster.go:1179 followResizeInstruction)."""
+    import io
+
     server.holder.apply_schema(msg.get("schema", []))
     for index_name, max_shard in msg.get("maxShards", {}).items():
         idx = server.holder.index(index_name)
@@ -222,8 +251,6 @@ def follow_resize_instruction(server, msg: dict) -> None:
                 f"from {src['sourceNodeID']}: {e}"
             )
             continue
-        import io
-
         fld = server.holder.field(src["index"], src["field"])
         if fld is None:
             continue

@@ -75,6 +75,12 @@ class HolderCleaner:
         removed: List[str] = []
         for index_name in holder.index_names():
             idx = holder.index(index_name)
+            # Pin the shard-space width BEFORE dropping fragments: the
+            # index's max shard is derived from local fragments, so GC'ing
+            # a handed-off tail shard would silently shrink this node's
+            # view of the index and full-index queries would stop fanning
+            # out to it (a hole served with no error).
+            idx.set_remote_max_shard(idx.max_shard())
             for field in idx.fields.values():
                 for view in field.views.values():
                     for shard in list(view.fragments):

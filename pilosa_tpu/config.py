@@ -85,6 +85,10 @@ from .parallel import EngineConfig  # noqa: E402
 # (cluster/health.py, stdlib-only). See docs/fault-tolerance.md.
 from .cluster.health import ResilienceConfig  # noqa: E402
 
+# And for [rebalance]: the live-migration knobs live with the elastic
+# rebalance machinery (cluster/rebalance.py). See docs/rebalance.md.
+from .cluster.rebalance import RebalanceConfig  # noqa: E402
+
 
 @dataclass
 class MetricConfig:
@@ -127,6 +131,7 @@ class Config:
     ingest: IngestConfig = field(default_factory=IngestConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    rebalance: RebalanceConfig = field(default_factory=RebalanceConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     translation: TranslationConfig = field(default_factory=TranslationConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
@@ -184,6 +189,20 @@ class Config:
             "hedge-max-fraction", self.resilience.hedge_max_fraction)
         self.resilience.hedge_min_delay = r.get(
             "hedge-min-delay", self.resilience.hedge_min_delay)
+        rb = d.get("rebalance", {})
+        self.rebalance.online = rb.get("online", self.rebalance.online)
+        self.rebalance.max_concurrent_streams = rb.get(
+            "max-concurrent-streams", self.rebalance.max_concurrent_streams)
+        self.rebalance.max_bytes_per_sec = rb.get(
+            "max-bytes-per-sec", self.rebalance.max_bytes_per_sec)
+        self.rebalance.catchup_threshold_bytes = rb.get(
+            "catchup-threshold-bytes", self.rebalance.catchup_threshold_bytes)
+        self.rebalance.max_catchup_rounds = rb.get(
+            "max-catchup-rounds", self.rebalance.max_catchup_rounds)
+        self.rebalance.cutover_pause_max = rb.get(
+            "cutover-pause-max", self.rebalance.cutover_pause_max)
+        self.rebalance.follower_timeout = rb.get(
+            "follower-timeout", self.rebalance.follower_timeout)
         s = d.get("scheduler", {})
         self.scheduler.max_queue = s.get("max-queue", self.scheduler.max_queue)
         self.scheduler.interactive_concurrency = s.get(
@@ -287,6 +306,19 @@ class Config:
             if v is not None:
                 setattr(self.resilience, attr, v)
         for attr, name, cast in [
+            ("online", "REBALANCE_ONLINE", bool),
+            ("max_concurrent_streams", "REBALANCE_MAX_CONCURRENT_STREAMS", int),
+            ("max_bytes_per_sec", "REBALANCE_MAX_BYTES_PER_SEC", float),
+            ("catchup_threshold_bytes",
+             "REBALANCE_CATCHUP_THRESHOLD_BYTES", int),
+            ("max_catchup_rounds", "REBALANCE_MAX_CATCHUP_ROUNDS", int),
+            ("cutover_pause_max", "REBALANCE_CUTOVER_PAUSE_MAX", float),
+            ("follower_timeout", "REBALANCE_FOLLOWER_TIMEOUT", float),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.rebalance, attr, v)
+        for attr, name, cast in [
             ("max_queue", "SCHED_MAX_QUEUE", int),
             ("interactive_concurrency", "SCHED_INTERACTIVE_CONCURRENCY", int),
             ("batch_concurrency", "SCHED_BATCH_CONCURRENCY", int),
@@ -362,6 +394,17 @@ class Config:
             "resilience_hedge_max_fraction":
                 ("resilience", "hedge_max_fraction"),
             "resilience_hedge_min_delay": ("resilience", "hedge_min_delay"),
+            "rebalance_online": ("rebalance", "online"),
+            "rebalance_max_concurrent_streams":
+                ("rebalance", "max_concurrent_streams"),
+            "rebalance_max_bytes_per_sec": ("rebalance", "max_bytes_per_sec"),
+            "rebalance_catchup_threshold_bytes":
+                ("rebalance", "catchup_threshold_bytes"),
+            "rebalance_max_catchup_rounds":
+                ("rebalance", "max_catchup_rounds"),
+            "rebalance_cutover_pause_max":
+                ("rebalance", "cutover_pause_max"),
+            "rebalance_follower_timeout": ("rebalance", "follower_timeout"),
             "sched_max_queue": ("scheduler", "max_queue"),
             "sched_interactive_concurrency": ("scheduler", "interactive_concurrency"),
             "sched_batch_concurrency": ("scheduler", "batch_concurrency"),
@@ -438,6 +481,15 @@ class Config:
             f"hedge-delay = {self.resilience.hedge_delay}",
             f"hedge-max-fraction = {self.resilience.hedge_max_fraction}",
             f"hedge-min-delay = {self.resilience.hedge_min_delay}",
+            "",
+            "[rebalance]",
+            f"online = {fmt(self.rebalance.online)}",
+            f"max-concurrent-streams = {self.rebalance.max_concurrent_streams}",
+            f"max-bytes-per-sec = {self.rebalance.max_bytes_per_sec}",
+            f"catchup-threshold-bytes = {self.rebalance.catchup_threshold_bytes}",
+            f"max-catchup-rounds = {self.rebalance.max_catchup_rounds}",
+            f"cutover-pause-max = {self.rebalance.cutover_pause_max}",
+            f"follower-timeout = {self.rebalance.follower_timeout}",
             "",
             "[scheduler]",
             f"max-queue = {self.scheduler.max_queue}",
@@ -520,6 +572,7 @@ class Config:
             ingest_config=self.ingest.validate(),
             engine_config=self.engine,
             resilience_config=self.resilience.validate(),
+            rebalance_config=self.rebalance.validate(),
         )
         kw.update(overrides)
         return Server(**kw)

@@ -250,6 +250,14 @@ class Fragment:
         # generations >= max(row floor, fragment floor).
         self._dirty_floor: Dict[int, int] = {}
         self._dirty_floor_all = 0
+        # Live-migration state (cluster/rebalance.py). _migrating counts
+        # open source-side sessions: while nonzero the snapshot policy
+        # defers so the WAL positions those sessions hold stay meaningful.
+        # _moved flips at shard cutover: the shard now lives on a new
+        # owner, and any write here must fail with ShardMovedError so the
+        # caller re-routes instead of acking into a doomed copy.
+        self._migrating = 0
+        self._moved = False
 
     # ---------------------------------------------------------------- open
 
@@ -527,8 +535,19 @@ class Fragment:
         base = (row_id * SHARD_WIDTH) >> 6
         return self.storage.words64(np.asarray(w64, dtype=np.int64) + base)
 
+    def _check_moved(self) -> None:
+        """Write gate for migrated-away fragments: raise BEFORE any
+        mutation so a re-routed retry applies the write exactly once, on
+        the new owner."""
+        if self._moved:
+            from ..errors import ShardMovedError
+
+            raise ShardMovedError(
+                f"{self.index}/{self.field}/{self.view}/{self.shard}")
+
     def set_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
+            self._check_moved()
             pos = self.pos(row_id, column_id)
             changed = self.storage.add(pos)
             if not changed:
@@ -542,6 +561,7 @@ class Fragment:
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
+            self._check_moved()
             pos = self.pos(row_id, column_id)
             changed = self.storage.remove(pos)
             if not changed:
@@ -640,6 +660,11 @@ class Fragment:
         threshold) OR op-log bytes exceeding snapshot-ratio x the last
         snapshot's container bytes (floored so a fresh fragment's first
         batches don't each trigger)."""
+        if self._migrating:
+            # Open migration sessions hold WAL positions into the current
+            # file layout; a snapshot would fold the tail away and force
+            # every stream back to a fresh base. Defer until they close.
+            return False
         if self.op_n >= self.max_op_n:
             return True
         ratio = self.storage_config.snapshot_ratio
@@ -985,6 +1010,7 @@ class Fragment:
         vote over {local} ∪ replicas, and applies the local diff.
         """
         with self._mu:
+            self._check_moved()
             # Vote on flat bit positions with numpy set ops — a dense 100-row
             # block holds up to 100 * 2^20 bits, so per-pair Python objects
             # (sets of tuples) are out of the question at scale.
@@ -1088,6 +1114,7 @@ class Fragment:
             column_ids % np.uint64(SHARD_WIDTH)
         )
         with self._mu:
+            self._check_moved()
             self.storage.add_many(positions)
             self._append_bulk_op(positions, None)
             self._invalidate_bulk(row_ids, positions)
@@ -1102,6 +1129,7 @@ class Fragment:
             column_ids % np.uint64(SHARD_WIDTH)
         )
         with self._mu:
+            self._check_moved()
             self.storage.remove_many(positions)
             self._append_bulk_op(None, positions)
             self._invalidate_bulk(row_ids, positions)
@@ -1115,6 +1143,7 @@ class Fragment:
         (adds and removes are disjoint positions, so replay order within
         the record is immaterial) instead of a snapshot."""
         with self._mu:
+            self._check_moved()
             column_ids = np.asarray(column_ids, dtype=np.uint64) % np.uint64(SHARD_WIDTH)
             values = np.asarray(values, dtype=np.uint64)
             # Every bit plane's changed words are a subset of the imported
@@ -1253,14 +1282,17 @@ class Fragment:
                 pass
             # Disarm copy-on-write too: leaving it set would make every
             # later first-touch mutation (and the next handoff's
-            # optimize) pay needless container copies.
+            # optimize) pay needless container copies. Refcounted: a
+            # concurrent migration base stream's clone keeps its
+            # protection.
             with self._mu:
-                self.storage._cow = None
+                self.storage.cow_release()
             raise
         with self._mu:
-            # The clone is fully serialized: stop copy-on-write so later
-            # mutations go back to mutating in place.
-            self.storage._cow = None
+            # The clone is fully serialized: drop this clone's
+            # copy-on-write protection (in-place mutation resumes once
+            # the last outstanding clone releases).
+            self.storage.cow_release()
             if (not self._opened or self._wal is None
                     or self._snapshot_seq != seq):
                 # Fragment closed, or an inline snapshot / replica restore
@@ -1410,3 +1442,57 @@ class Fragment:
             self.cache.invalidate(force=True)
             if self.path:
                 self.snapshot()
+
+    # ------------------------------------------------------- live migration
+
+    def _migrate_invalidate(self) -> None:
+        # Must hold _mu. Wholesale storage change with no per-word
+        # history: poison every cached generation (full regather) and
+        # stale-proof the batcher/memo via the epoch.
+        self._plane_cache.clear()
+        self._checksums.clear()
+        self.generation += 1
+        self._journal_reset()
+        if self.epoch is not None:
+            self.epoch.bump()
+
+    def migrate_install(self, data: bytes) -> None:
+        """Install a migration base snapshot (a serialized container
+        section shipped by a source's /internal/migrate/begin). Unlike
+        read_from there is no length frame and no snapshot here — the
+        catch-up tail is still coming; migrate_seal persists."""
+        bm = Bitmap.from_bytes(data)
+        if bm.truncated_bytes:
+            raise PilosaError(
+                f"torn migration base for {self.index}/{self.field}/"
+                f"{self.view}/{self.shard}: {bm.truncated_bytes} trailing "
+                "bytes unparseable"
+            )
+        with self._mu:
+            self.storage = bm
+            self.op_n = 0
+            self.cache.clear()
+            self._migrate_invalidate()
+
+    def migrate_apply_ops(self, data: bytes) -> None:
+        """Replay a shipped WAL catch-up tail (point + bulk records, the
+        exact on-disk codec) over the installed base. Replay over a base
+        serialized concurrently with these ops is safe: set/clear of a
+        bit position is idempotent, so a record that also made the base
+        re-applies to the same state."""
+        from ..storage.bitmap import replay_ops
+
+        with self._mu:
+            replay_ops(self.storage, data)
+            self._migrate_invalidate()
+
+    def migrate_seal(self) -> None:
+        """Migration complete for this fragment: rebuild the rank cache
+        and persist (containers + replayed tail folded into one file)."""
+        with self._mu:
+            self.cache.clear()
+            for row_id in self.rows():
+                self.cache.bulk_add(row_id, self.row_count(row_id))
+            self.cache.invalidate(force=True)
+        if self.path:
+            self.snapshot()

@@ -129,6 +129,24 @@ class DiagnosticsCollector:
                 1 for p in snap.get("peers", {}).values()
                 if p.get("state") != "closed"
             )
+        # Elastic-rebalance shape: how much data live migrations have
+        # moved, what cutovers cost the write path, and whether a job is
+        # in flight right now (mid-job routing carries per-shard
+        # overrides; per-shard detail stays in /debug/vars).
+        stats = getattr(self.server, "rebalance_stats", None)
+        if stats is not None:
+            snap = stats.snapshot()
+            info["rebalanceJobsCompleted"] = snap.get("jobs_completed", 0)
+            info["rebalanceJobsAborted"] = snap.get("jobs_aborted", 0)
+            info["rebalanceJobsResumed"] = snap.get("jobs_resumed", 0)
+            info["rebalanceFragmentsMoved"] = snap.get("fragments_moved", 0)
+            info["rebalanceBytesStreamed"] = snap.get("bytes_streamed", 0)
+            info["rebalanceShardsCutOver"] = snap.get("shards_cut_over", 0)
+            info["rebalanceCutoverPauseMsP99"] = snap.get(
+                "cutover_pause_ms_p99")
+            info["rebalanceEpoch"] = self.server.cluster.routing_epoch
+            info["rebalanceActive"] = (
+                self.server.cluster.next_nodes is not None)
         info.update(system_info())
         info.update(self._extra)
         return info

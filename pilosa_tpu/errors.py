@@ -89,6 +89,24 @@ class TranslateStoreReadOnlyError(PilosaError):
     message = "translate store is read-only"
 
 
+class ShardMovedError(PilosaError):
+    """A write reached a fragment whose shard cut over to a new owner
+    during a live rebalance (cluster/rebalance.py). Maps to HTTP 409;
+    callers re-route on refreshed placement instead of retrying the
+    same node."""
+
+    message = "shard migrated to a new owner"
+
+
+class StaleRoutingEpochError(PilosaError):
+    """A forwarded request was stamped with a routing epoch older than
+    the receiver's and touches shards the receiver no longer serves.
+    Maps to HTTP 409: one re-route on refreshed placement — never an
+    empty answer from a moved/GC'd shard, never a retry storm."""
+
+    message = "stale routing epoch"
+
+
 class CorruptFragmentError(PilosaError, ValueError):
     """On-disk fragment/bitmap data failed validation (bad cookie, bogus
     container payload, checksum-failing op record). Carries where the file

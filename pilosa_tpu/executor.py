@@ -78,6 +78,11 @@ class ExecOptions:
     # an expired query stops consuming device time instead of pinning
     # threads; the REMAINING budget rides forwarded requests' headers.
     deadline: Optional[Any] = None
+    # Sender's routing epoch on forwarded requests (live rebalance,
+    # cluster/rebalance.py): when this node has advanced past it AND no
+    # longer serves a requested shard, the request 409s so the sender
+    # re-routes once — never an empty answer from a migrated/GC'd shard.
+    epoch: Optional[int] = None
 
 
 @dataclass
@@ -142,6 +147,12 @@ class Executor:
         # that fragment reading as EMPTY rather than erroring — this
         # counter surfaces how often results were degraded (/debug/vars).
         self.quarantined_reads = 0
+        # How long a write caught in a live-rebalance cutover window
+        # (ShardMovedError locally, 409 from a frozen remote owner) keeps
+        # re-routing while the commit broadcast lands, before surfacing a
+        # clean retryable error. The server installs
+        # [rebalance] cutover-pause-max here.
+        self.cutover_wait = 2.0
         from .logger import NopLogger
 
         self.logger = NopLogger()  # server wires its logger in open()
@@ -207,6 +218,26 @@ class Executor:
         if not shards and needs_shards:
             shards = list(range(idx.max_shard() + 1))
         shards = list(shards or [])
+
+        if (
+            opt.remote
+            and opt.epoch is not None
+            and opt.epoch < self.cluster.routing_epoch
+        ):
+            # The sender routed under an older placement than ours. Serving
+            # a shard we no longer own would read a migrated (possibly
+            # GC'd) fragment as empty — a silent hole. 409 instead; the
+            # sender re-routes once on refreshed placement.
+            for shard in shards:
+                if not any(n.id == self.node.id
+                           for n in self.cluster.shard_nodes(index, shard)):
+                    from .errors import StaleRoutingEpochError
+
+                    raise StaleRoutingEpochError(
+                        f"shard {shard} of {index} no longer served here "
+                        f"(request epoch {opt.epoch} < local "
+                        f"{self.cluster.routing_epoch})"
+                    )
 
         results = []
         for call in query.calls:
@@ -375,6 +406,11 @@ class Executor:
                     continue  # remote calls are restricted to local shards
                 node = self.cluster.node_by_id(node_id)
                 kw = {}
+                if self.cluster.routing_epoch:
+                    # Stamp the routing epoch only once a rebalance has
+                    # ever advanced it — duck-typed test clients without
+                    # the parameter keep working untouched.
+                    kw["epoch"] = self.cluster.routing_epoch
                 if opt.deadline is not None:
                     # Abort before the hop, and forward only the REMAINING
                     # budget so the peer never works past our cutoff. The
@@ -403,6 +439,17 @@ class Executor:
                         self.health.record_success(node_id)
                         app_error = app_error or e
                         failed.add(node_id)
+                        if getattr(e, "status", 0) == 409:
+                            # Routing conflict (live-rebalance cutover):
+                            # ONE free re-route on refreshed placement —
+                            # this is a placement change, not survivor
+                            # load amplification, so it must not drain
+                            # the retry budget into a retry storm.
+                            if self.holder.stats is not None:
+                                self.holder.stats.count(
+                                    "StaleEpochReroute", 1)
+                            pending.extend(node_shards)
+                            continue
                         if not self.health.try_spend_retry():
                             # Budget drained: surface the rejection now
                             # instead of adding replica load.
@@ -1125,7 +1172,37 @@ class Executor:
         repairs a lagging replica when it returns), finish the whole loop
         before surfacing a deterministic 4xx rejection (so one lagging
         replica cannot cause extra divergence on the others), and raise
-        only if NO owner applied."""
+        only if NO owner applied.
+
+        Live-rebalance cutovers surface here as ShardMovedError (the
+        local fragment froze) or a 409 from a frozen remote owner: the
+        write re-routes on refreshed placement — re-applying to an owner
+        that already took it is an idempotent set/clear — and keeps
+        retrying up to `cutover_wait` while the commit broadcast lands,
+        so a write racing the cutover follows the shard to its new owner
+        instead of failing. Past the cap it surfaces clean (retryable)."""
+        import time as _time
+
+        from .errors import ShardMovedError
+
+        deadline = _time.monotonic() + (0.0 if remote else
+                                        max(self.cutover_wait, 0.0))
+        while True:
+            try:
+                self._owner_fanout_once(
+                    index, shard, remote, local_fn, forward_fn, on_forward_ok)
+                return
+            except PilosaError as e:
+                mid_cutover = isinstance(e, ShardMovedError) or (
+                    getattr(e, "status", 0) == 409)
+                if not mid_cutover or _time.monotonic() >= deadline:
+                    raise
+                if self.holder.stats is not None:
+                    self.holder.stats.count("CutoverWriteWait", 1)
+                _time.sleep(0.02)
+
+    def _owner_fanout_once(self, index, shard, remote, local_fn, forward_fn,
+                           on_forward_ok):
         applied = 0
         errors = []
         app_error = [None]
@@ -1133,7 +1210,19 @@ class Executor:
         def note(e):
             app_error[0] = app_error[0] or e
 
-        for node in self.cluster.shard_nodes(index, shard):
+        owners = self.cluster.shard_nodes(index, shard)
+        if remote and not any(n.id == self.node.id for n in owners):
+            # A forwarded write for a shard this node no longer serves
+            # (the sender routed under a pre-cutover placement). The old
+            # behavior — count every non-self owner as applied-by-
+            # forwarder and ack — SILENTLY DROPPED the write: zero
+            # fragments were touched. Raise instead (HTTP 409) so the
+            # sender re-routes to the shard's current owner.
+            from .errors import ShardMovedError
+
+            raise ShardMovedError(
+                f"{index}/shard {shard} is not served by this node")
+        for node in owners:
             if node.id == self.node.id:
                 local_fn()
                 applied += 1
@@ -1173,6 +1262,16 @@ class Executor:
 
         # Placement resolved up front: one routing decision per import.
         plan = {int(s): self.cluster.shard_nodes(index, int(s)) for s in shards}
+        if remote:
+            from .errors import ShardMovedError
+
+            for shard, owners in plan.items():
+                if not any(n.id == self.node.id for n in owners):
+                    # Same silent-drop hazard as the single-shard fanout:
+                    # a forwarded batch for a migrated-away shard must
+                    # 409 so the sender re-routes, not ack into the void.
+                    raise ShardMovedError(
+                        f"{index}/shard {shard} is not served by this node")
         applied = {s: 0 for s in plan}
         errors: List[str] = []
         app_error: List[Optional[Exception]] = [None]

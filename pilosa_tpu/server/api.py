@@ -103,6 +103,7 @@ class API:
         remote: bool = False,
         deadline=None,
         traffic_class: Optional[str] = None,
+        epoch: Optional[int] = None,
     ) -> List[Any]:
         """Execute PQL under the query scheduler's lifecycle: admit (429
         when the queue is full) -> wait (bounded by `deadline`) ->
@@ -117,6 +118,7 @@ class API:
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns,
             deadline=deadline,
+            epoch=epoch,
         )
         sched = getattr(self.server, "scheduler", None)
         if sched is None:

@@ -244,11 +244,15 @@ class InternalClient:
 
     def query_node(self, node, index: str, query: str,
                    shards: Optional[Sequence[int]] = None, remote: bool = True,
-                   deadline: Optional[float] = None) -> List[Any]:
+                   deadline: Optional[float] = None,
+                   epoch: Optional[int] = None) -> List[Any]:
         """Execute PQL on a peer restricted to its shards (http/client.go
         QueryNode). `deadline` is the coordinator's REMAINING budget in
         seconds; it rides X-Pilosa-Deadline so the peer aborts its own
-        device dispatches at the same cutoff."""
+        device dispatches at the same cutoff. `epoch` is the sender's
+        routing epoch (X-Pilosa-Epoch): a peer that has advanced past it
+        and no longer serves the requested shards answers 409 instead of
+        a hole from a migrated/GC'd fragment."""
         from . import wire
 
         params = {"remote": "true"} if remote else {}
@@ -256,9 +260,12 @@ class InternalClient:
         if params:
             url += "?" + urllib.parse.urlencode(params)
         body = json.dumps({"query": query, "shards": list(shards) if shards else None}).encode()
-        extra = None
+        extra = {}
         if deadline is not None:
-            extra = {"X-Pilosa-Deadline": f"{max(deadline, 0.0):.6f}"}
+            extra["X-Pilosa-Deadline"] = f"{max(deadline, 0.0):.6f}"
+        if epoch is not None:
+            extra["X-Pilosa-Epoch"] = str(int(epoch))
+        extra = extra or None
         raw = self._request("POST", url, body, accept=wire.CONTENT_TYPE,
                             extra_headers=extra)
         # Binary data plane when the peer speaks it (packed bitplanes);
@@ -510,6 +517,46 @@ class InternalClient:
             if e.status == 404:
                 return {"rowIDs": [], "columnIDs": []}
             raise
+
+    # ------------------------------------------------------ live migration
+
+    def migrate_begin(self, uri, index: str, field: str, view: str,
+                      shard: int):
+        """Open a migration stream for one fragment: returns (header,
+        base_bytes) where header carries the session id and the WAL
+        position the base corresponds to (cluster/rebalance.py framing)."""
+        from ..cluster.rebalance import unpack_framed
+
+        body = json.dumps({"index": index, "field": field, "view": view,
+                           "shard": shard}).encode()
+        raw = self._request(
+            "POST", f"{_node_url(uri)}/internal/migrate/begin", body)
+        return unpack_framed(raw)
+
+    def migrate_delta(self, uri, session: str, from_pos=None):
+        """Pull the WAL tail appended since `from_pos` (the receiver's
+        cursor — sending it makes a retried pull re-read the same chunk,
+        never skip one): (header, wal_bytes); header {"restart": true}
+        means the source's file layout changed and the stream must begin
+        again."""
+        from ..cluster.rebalance import unpack_framed
+
+        body = json.dumps({"session": session, "from": from_pos}).encode()
+        raw = self._request(
+            "POST", f"{_node_url(uri)}/internal/migrate/delta", body)
+        return unpack_framed(raw)
+
+    def migrate_freeze(self, uri, index: str, shard: int) -> dict:
+        """Cut a shard over on its source: fragments stop accepting
+        writes and the source's routing flips to the new owner."""
+        body = json.dumps({"index": index, "shard": shard}).encode()
+        return json.loads(self._request(
+            "POST", f"{_node_url(uri)}/internal/migrate/freeze", body))
+
+    def migrate_close(self, uri, sessions) -> None:
+        body = json.dumps({"sessions": list(sessions)}).encode()
+        self._request(
+            "POST", f"{_node_url(uri)}/internal/migrate/close", body)
 
     def retrieve_shard_from_uri(self, uri: str, index: str, field: str, view: str, shard: int) -> bytes:
         url = (f"{_node_url(uri)}/internal/fragment/data?"

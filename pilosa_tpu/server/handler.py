@@ -120,6 +120,10 @@ class Handler:
             Route("GET", r"/internal/fragment/nodes", self.handle_fragment_nodes),
             Route("GET", r"/internal/fragment/data", self.handle_fragment_data),
             Route("POST", r"/internal/fragment/data", self.handle_post_fragment_data),
+            Route("POST", r"/internal/migrate/begin", self.handle_migrate_begin),
+            Route("POST", r"/internal/migrate/delta", self.handle_migrate_delta),
+            Route("POST", r"/internal/migrate/freeze", self.handle_migrate_freeze),
+            Route("POST", r"/internal/migrate/close", self.handle_migrate_close),
             Route("GET", r"/internal/shards/max", self.handle_shards_max),
             Route("GET", r"/internal/translate/data", self.handle_translate_data),
             Route("POST", r"/internal/index/(?P<index>[^/]+)/attr/diff", self.handle_index_attr_diff),
@@ -183,6 +187,15 @@ class Handler:
                     # clients/balancers treat it as overload, not a bad
                     # request.
                     return (503, "application/json",
+                            json.dumps({"error": str(e)}).encode())
+                from ..errors import ShardMovedError, StaleRoutingEpochError
+
+                if isinstance(e, (ShardMovedError, StaleRoutingEpochError)):
+                    # Routing conflict (live rebalance cutover): 409 tells
+                    # the sender to re-route once on refreshed placement —
+                    # distinct from 400 (deterministic rejection) and 5xx
+                    # (node fault), neither of which should re-route.
+                    return (409, "application/json",
                             json.dumps({"error": str(e)}).encode())
                 # Missing fragments map to 404 so the anti-entropy client can
                 # treat the replica as empty instead of failing the sync
@@ -333,6 +346,15 @@ class Handler:
         deadline = None
         if scheduler is not None:
             deadline = scheduler.deadline_for(headers.get("x-pilosa-deadline"))
+        # Sender's routing epoch (live rebalance): lets this node detect a
+        # forwarded request routed under a placement older than its own.
+        epoch = None
+        raw_epoch = headers.get("x-pilosa-epoch")
+        if raw_epoch:
+            try:
+                epoch = int(raw_epoch)
+            except ValueError:
+                epoch = None
         remote = query.get("remote", ["false"])[0] == "true"
         column_attrs = query.get("columnAttrs", ["false"])[0] == "true"
         exclude_row_attrs = query.get("excludeRowAttrs", ["false"])[0] == "true"
@@ -384,7 +406,7 @@ class Handler:
 
         if remote:
             results = self.api.query(index, pql, shards=shards, remote=True,
-                                     deadline=deadline)
+                                     deadline=deadline, epoch=epoch)
             from . import wire
 
             if wire.CONTENT_TYPE in headers.get("accept", ""):
@@ -538,6 +560,35 @@ class Handler:
         frag.read_from(io.BytesIO(body))
         return {}
 
+    def handle_migrate_begin(self, body, **kw):
+        """Open a live-migration stream for one fragment: the response is
+        a binary frame (header json + raw base bytes, cluster/rebalance.py
+        framing) so a multi-MiB fragment base never rides base64."""
+        from ..cluster.rebalance import pack_framed
+
+        req = _json_body(body)
+        hdr, data = self.api.server.migration_source.begin(
+            req["index"], req["field"], req["view"], int(req["shard"]))
+        return 200, "application/octet-stream", pack_framed(hdr, data)
+
+    def handle_migrate_delta(self, body, **kw):
+        from ..cluster.rebalance import pack_framed
+
+        req = _json_body(body)
+        hdr, data = self.api.server.migration_source.delta(
+            req["session"], from_pos=req.get("from"))
+        return 200, "application/octet-stream", pack_framed(hdr, data)
+
+    def handle_migrate_freeze(self, body, **kw):
+        req = _json_body(body)
+        return self.api.server.migration_source.freeze(
+            req["index"], int(req["shard"]))
+
+    def handle_migrate_close(self, body, **kw):
+        req = _json_body(body)
+        self.api.server.migration_source.close(req.get("sessions", []))
+        return {}
+
     def handle_shards_max(self, **kw):
         return {"standard": self.api.shards_max()}
 
@@ -608,6 +659,19 @@ class Handler:
         # peer costs zero connect attempts between half-open probes" and
         # "replica retries stayed inside the budget".
         out["resilience"] = self.api.server.cluster.health.snapshot()
+        # Live-rebalance health (docs/rebalance.md): fragments moved vs
+        # pending, bytes streamed, catch-up rounds, cutover write-pause
+        # percentiles, and the routing epoch — the on-call question during
+        # an elastic resize is "is the migration making progress, and what
+        # did cutovers cost the write path".
+        stats = getattr(self.api.server, "rebalance_stats", None)
+        if stats is not None:
+            cluster = self.api.server.cluster
+            rb = stats.snapshot()
+            rb["epoch"] = cluster.routing_epoch
+            rb["active"] = cluster.next_nodes is not None
+            rb["migrated_shards"] = len(cluster.migrated)
+            out["rebalance"] = rb
         from .. import failpoints as _fp
 
         if _fp.active():
@@ -795,7 +859,10 @@ class _Server(ThreadingHTTPServer):
         events. Anything else keeps the stdlib's loud default."""
         import sys
 
-        exc = sys.exception()
+        # sys.exc_info, not sys.exception: the latter is 3.11+ and this
+        # runs on 3.10 — an AttributeError here replaced every quiet
+        # disconnect with a scarier traceback of its own.
+        exc = sys.exc_info()[1]
         if isinstance(exc, (ConnectionResetError, BrokenPipeError,
                             ConnectionAbortedError, TimeoutError)):
             return

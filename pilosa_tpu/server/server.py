@@ -58,6 +58,7 @@ class Server:
         member_probe_failures: int = 3,
         coordinator_failover_probes: int = 3,
         resilience_config=None,
+        rebalance_config=None,
         internal_key_path: Optional[str] = None,
         scheduler_config=None,
         storage_config=None,
@@ -117,6 +118,29 @@ class Server:
         if resilience_config is not None:
             self.cluster.health.configure(resilience_config.validate())
         self._static_hosts = cluster_hosts or []
+        # Live-rebalance roles (cluster/rebalance.py): every node can be a
+        # migration source and receiver; the coordinator object is built
+        # on demand like the legacy resize coordinator.
+        from ..cluster.rebalance import (
+            MigrationSource, RebalanceConfig, RebalanceReceiver,
+            RebalanceStats,
+        )
+
+        self.rebalance_config = (
+            rebalance_config or RebalanceConfig()).validate()
+        self.rebalance_stats = RebalanceStats()
+        self.migration_source = MigrationSource(self)
+        self.rebalance_receiver = RebalanceReceiver(self)
+        self.rebalance_coordinator = None
+        # Follower resize watchdog (legacy stop-the-world path): when a
+        # cluster-status flipped this node to RESIZING, the monotonic time
+        # it happened — a coordinator that died before delivering
+        # instructions must not strand us RESIZING forever.
+        self._resizing_since: Optional[float] = None
+        # Idempotency for rebalance lifecycle messages: transport retries
+        # can deliver begin/complete/abort twice, and e.g. a re-applied
+        # complete would bump the routing epoch a second time.
+        self._rebalance_seen: dict = {}
 
         self.holder = Holder(
             os.path.join(data_dir, "indexes") if data_dir else None,
@@ -164,6 +188,9 @@ class Server:
             workers=executor_workers,
             engine_config=engine_config,
         )
+        # Writes racing a live-rebalance cutover re-route/wait up to this
+        # long for the commit broadcast before failing clean.
+        self.executor.cutover_wait = self.rebalance_config.cutover_pause_max
         # Query scheduler (sched/): admission control + deadlines +
         # cross-query micro-batching, the gate between the HTTP handler
         # and the executor. The batcher pulls the engine LAZILY so
@@ -362,6 +389,24 @@ class Server:
         self.opened = True
         if self.join_addr:
             self._join_cluster()
+        elif (
+            self.node.is_coordinator
+            and self.data_dir
+            and self.cluster.state == STATE_NORMAL
+            and self.rebalance_config.online
+            and os.path.exists(os.path.join(self.data_dir, ".rebalance.json"))
+        ):
+            # A checkpointed rebalance job survived a coordinator restart:
+            # resume it (committed shards skip straight past) once the
+            # HTTP plane is up and peers have had a beat to answer.
+            def _resume():
+                time.sleep(1.0)
+                if not self._stop.is_set():
+                    self.maybe_resume_rebalance()
+
+            threading.Thread(
+                target=_resume, name="rebalance-resume", daemon=True
+            ).start()
         return self
 
     def _needs_topology_quorum(self) -> bool:
@@ -404,6 +449,14 @@ class Server:
                 # take arbitrarily long in a staggered restart.
                 if self.cluster.state in (STATE_NORMAL, STATE_STARTING):
                     return
+            if self.cluster.next_nodes is not None and any(
+                n.id == self.node.id for n in self.cluster.next_nodes
+            ):
+                # Admission via a live rebalance: this node is in the
+                # TARGET membership and shard migration is running; it
+                # joins `nodes` when the job completes. The join call
+                # itself is done.
+                return
             time.sleep(0.05)
         raise PilosaError(f"timed out joining cluster via {self.join_addr}")
 
@@ -504,9 +557,18 @@ class Server:
         self._retopologize(new_nodes)
 
     def _retopologize(self, new_nodes: List[Node], extra_recipients=()) -> None:
-        """Apply a membership change: resize job when data exists, plain
-        status broadcast otherwise."""
+        """Apply a membership change: resize job when data exists (the
+        live online rebalance by default, the legacy stop-the-world
+        resizeJob when [rebalance] online=false), plain status broadcast
+        otherwise."""
         if self.holder.indexes:
+            if self.rebalance_config.online:
+                from ..cluster.rebalance import RebalanceCoordinator
+
+                if self.rebalance_coordinator is None:
+                    self.rebalance_coordinator = RebalanceCoordinator(self)
+                self.rebalance_coordinator.begin(new_nodes)
+                return
             from ..cluster.resize import ResizeCoordinator
 
             if self.resize_coordinator is None:
@@ -616,6 +678,7 @@ class Server:
         probes, gossip/gossip.go). Probes peer /status; marks nodes
         unavailable so the executor routes around them, and re-marks them
         available on recovery."""
+        self._check_resize_watchdog()
         for node in list(self.cluster.nodes):
             if node.id == self.node.id:
                 continue
@@ -625,8 +688,15 @@ class Server:
                 self._probe_failures[node.id] = \
                     self._probe_failures.get(node.id, 0) + 1
                 was_down = node.id in self.cluster.unavailable
+                # Copy-load grace (live rebalance): a peer streaming
+                # migration data answers probes slowly under expected
+                # load — require proportionally more consecutive misses
+                # before rerouting every shard it owns.
+                probe_threshold = self.member_probe_failures
+                if self.cluster.health.in_copy_grace(node.id):
+                    probe_threshold *= self.cluster.health.COPY_GRACE_MULT
                 if was_down or (
-                    self._probe_failures[node.id] >= self.member_probe_failures
+                    self._probe_failures[node.id] >= probe_threshold
                 ):
                     # Flap damping (gossip.probe-failures): a single
                     # transient probe timeout no longer reroutes every
@@ -899,6 +969,14 @@ class Server:
                 # carries partial membership and must not clobber the
                 # persisted topology peers use for their own quorum.
                 self.topology.save(self.cluster.nodes)
+            # Follower resize watchdog bookkeeping (legacy stop-the-world
+            # path): remember when RESIZING started so a dead coordinator
+            # can't strand this node in it forever.
+            if self.cluster.state == STATE_RESIZING:
+                if not self.node.is_coordinator and self._resizing_since is None:
+                    self._resizing_since = time.monotonic()
+            else:
+                self._resizing_since = None
             if prev_state == STATE_RESIZING and self.cluster.state == STATE_NORMAL:
                 # Post-resize GC of shards this node no longer owns
                 # (reference holderCleaner, holder.go:777-835).
@@ -961,8 +1039,188 @@ class Server:
             self.collective.receive(msg)
         elif typ == "node-state":
             pass  # coordinator bookkeeping; static clusters are always NORMAL
+        elif typ == "rebalance-begin":
+            self._handle_rebalance_begin(msg)
+        elif typ == "rebalance-instruction":
+            # Migration streams can run minutes; the handler thread must
+            # return as soon as the instruction is DELIVERED (same shape
+            # as the legacy resize-instruction follower). Deduped on
+            # (jobID, attempt): a transport-retried duplicate must not
+            # double-stream, but a RESUMED job reuses its jobID with a
+            # bumped attempt and must stream again.
+            if not self._rebalance_dedupe("instruction", msg):
+                threading.Thread(
+                    target=self.rebalance_receiver.handle_instruction,
+                    args=(msg,), name="rebalance-receiver", daemon=True,
+                ).start()
+        elif typ == "rebalance-finalize":
+            threading.Thread(
+                target=self.rebalance_receiver.handle_finalize,
+                args=(msg,), name="rebalance-finalize", daemon=True,
+            ).start()
+        elif typ == "rebalance-shard-ready":
+            if self.rebalance_coordinator is not None:
+                self.rebalance_coordinator.shard_ready(msg)
+        elif typ == "rebalance-shard-done":
+            if self.rebalance_coordinator is not None:
+                self.rebalance_coordinator.shard_done(msg)
+        elif typ == "rebalance-shard-failed":
+            if self.rebalance_coordinator is not None:
+                self.rebalance_coordinator.shard_failed(msg)
+        elif typ == "cutover-commit":
+            # The freeze->commit window is the shard's effective write
+            # pause; a freeze this node performed as the source closes
+            # its sample here.
+            self.rebalance_stats.note_commit(
+                msg["index"], int(msg["shard"]),
+                pause_cap=self.rebalance_config.cutover_pause_max)
+            self.cluster.apply_cutover(
+                msg["index"], int(msg["shard"]), epoch=msg.get("epoch"))
+        elif typ == "rebalance-complete":
+            self._handle_rebalance_complete(msg)
+        elif typ == "rebalance-abort":
+            self._handle_rebalance_abort(msg)
         else:
             self.logger.error("unknown cluster message type: %s", typ)
+
+    # ------------------------------------------------------- live rebalance
+
+    def _rebalance_dedupe(self, kind: str, msg: dict) -> bool:
+        """True when this lifecycle message was already applied for the
+        message's (jobID, attempt) — duplicate delivery via transport
+        retry. The attempt rides every lifecycle message because a
+        RESUMED job reuses its jobID: deduping on jobID alone would
+        swallow the resumed begin/abort (e.g. a committed set persisted
+        just before a coordinator crash, whose commit broadcast never
+        went out, reaches peers only via the resumed begin)."""
+        job_id = msg.get("jobID")
+        if not job_id:
+            return False
+        token = f"{job_id}#{msg.get('attempt', 0)}"
+        if self._rebalance_seen.get(kind) == token:
+            return True
+        self._rebalance_seen[kind] = token
+        return False
+
+    def _handle_rebalance_begin(self, msg: dict) -> None:
+        if self._rebalance_dedupe("begin", msg):
+            return
+        new_nodes = [Node.from_dict(n) for n in msg.get("newNodes", [])]
+        current = [Node.from_dict(n) for n in msg.get("nodes", [])]
+        if (
+            current
+            and len(self.cluster.nodes) <= 1
+            and not any(n.id == self.node.id for n in current)
+        ):
+            # A joining node: adopt the CURRENT membership for placement
+            # (it owns nothing until cutovers commit; adding itself to the
+            # node list would corrupt the jump-hash placement every other
+            # node computes).
+            self.cluster.nodes = current
+        self.cluster.begin_rebalance(
+            new_nodes,
+            committed=[tuple(x) for x in msg.get("committed", [])],
+            epoch=msg.get("epoch"),
+        )
+        for nid in msg.get("participants", []):
+            self.cluster.health.set_copy_grace(nid)
+
+    def _handle_rebalance_complete(self, msg: dict) -> None:
+        if self._rebalance_dedupe("complete", msg):
+            return
+        nodes = [Node.from_dict(n) for n in msg.get("nodes", [])]
+        self.cluster.commit_topology(nodes, epoch=msg.get("epoch"))
+        self.cluster.health.clear_copy_grace()
+        live = {n.id for n in self.cluster.nodes}
+        self.cluster.health.prune_absent(live)
+        for nid in [k for k in self._probe_failures if k not in live]:
+            del self._probe_failures[nid]
+        self.topology.save(self.cluster.nodes)
+        # Epoch-guarded GC: the commit advanced the routing epoch, so a
+        # read still routed under the old placement 409s and re-routes
+        # instead of reading the removed fragment as empty.
+        from ..cluster.topology import HolderCleaner
+
+        removed = HolderCleaner(self).clean_holder()
+        if removed:
+            self.logger.info(
+                "rebalance complete: holder cleaner removed %d fragments",
+                len(removed))
+
+    def _handle_rebalance_abort(self, msg: dict) -> None:
+        if self._rebalance_dedupe("abort", msg):
+            return
+        self.rebalance_receiver.handle_abort(msg)
+        self.migration_source.abort_all()
+        committed = [tuple(x) for x in msg.get("committed", [])]
+        # Thaw fragments frozen for never-committed cutovers: routing for
+        # those shards reverts to this node, and a lingering _moved flag
+        # would leave them permanently write-dead.
+        self.migration_source.unfreeze(keep=committed)
+        reverted = self.cluster.abort_rebalance(committed=committed)
+        self.cluster.health.clear_copy_grace()
+        if reverted and any(n.id == self.node.id for n in self.cluster.nodes):
+            # Members drop half-fetched fragments for shards they don't
+            # own on the reverted topology. A JOINER skips this: it is in
+            # no topology at all here, and a cleaner pass would delete any
+            # pre-existing local data it brought to the join.
+            from ..cluster.topology import HolderCleaner
+
+            HolderCleaner(self).clean_holder()
+
+    def maybe_resume_rebalance(self) -> bool:
+        """Pick up a checkpointed rebalance job after a coordinator
+        restart. Returns True when a job was resumed."""
+        if not self.node.is_coordinator or not self.rebalance_config.online:
+            return False
+        from ..cluster.rebalance import RebalanceCoordinator
+
+        if self.rebalance_coordinator is None:
+            self.rebalance_coordinator = RebalanceCoordinator(self)
+        try:
+            return self.rebalance_coordinator.resume()
+        except PilosaError as e:
+            self.logger.error("rebalance resume failed: %s", e)
+            return False
+
+    def _check_resize_watchdog(self) -> None:
+        """Follower resize watchdog (legacy stop-the-world path): a
+        coordinator that died after broadcasting RESIZING but before (or
+        during) instruction delivery strands followers — membership never
+        flipped, so after `rebalance.follower-timeout` with a coordinator
+        that is unreachable or no longer resizing, revert to NORMAL on
+        the old topology. A live coordinator still mid-job resets the
+        timer instead."""
+        if (
+            self.cluster.state != STATE_RESIZING
+            or self.node.is_coordinator
+            or self._resizing_since is None
+        ):
+            return
+        if time.monotonic() - self._resizing_since < (
+            self.rebalance_config.follower_timeout
+        ):
+            return
+        coordinator = self.cluster.coordinator_node()
+        coordinator_resizing = False
+        if coordinator is not None:
+            try:
+                status = self._probe_client.status(coordinator.uri)
+                coordinator_resizing = status.get("state") == STATE_RESIZING
+            except PilosaError:
+                coordinator_resizing = False
+        if coordinator_resizing:
+            self._resizing_since = time.monotonic()  # job still live
+            return
+        self.logger.error(
+            "resize watchdog: coordinator %s gone or no longer resizing "
+            "after %.0fs in RESIZING; reverting to NORMAL on the old "
+            "topology",
+            coordinator.id if coordinator else "<unknown>",
+            self.rebalance_config.follower_timeout,
+        )
+        self.cluster.state = STATE_NORMAL
+        self._resizing_since = None
 
     def _on_new_shard(self, index: str, field: str, shard: int) -> None:
         """View created a new shard fragment -> broadcast (view.go:210-257)."""
@@ -972,6 +1230,10 @@ class Server:
             )
 
     def resize_abort(self) -> None:
+        rebalancer = getattr(self, "rebalance_coordinator", None)
+        if rebalancer is not None and rebalancer.job is not None:
+            rebalancer.abort("operator requested abort")
+            return
         coordinator = getattr(self, "resize_coordinator", None)
         if coordinator is not None and coordinator.job is not None:
             # Drop the job too: state-only reset would leave the job live,

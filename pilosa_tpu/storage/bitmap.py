@@ -687,7 +687,7 @@ class Bitmap:
     """Two-form-container bitmap over uint64 values."""
 
     __slots__ = ("containers", "op_n", "_skeys", "valid_len",
-                 "truncated_bytes", "ops_bytes", "_cow")
+                 "truncated_bytes", "ops_bytes", "_cow", "_cow_refs")
 
     def __init__(self, values=None):
         # key (value >> 16) -> Container of low 16 bits
@@ -705,8 +705,12 @@ class Bitmap:
         self._skeys: Optional[np.ndarray] = None  # sorted key cache
         # Keys whose containers are shared with a cow_clone() snapshot: the
         # next mutation of such a container copies it first, so the clone
-        # stays frozen while live writes proceed (background snapshots).
+        # stays frozen while live writes proceed (background snapshots,
+        # migration base streams). Refcounted: a background snapshot and a
+        # migration begin can hold clones simultaneously, and one clone's
+        # release must not strip the other's protection.
         self._cow: Optional[set] = None
+        self._cow_refs = 0
         if values is not None:
             self.add_many(np.asarray(values, dtype=np.uint64))
 
@@ -748,16 +752,30 @@ class Bitmap:
     def cow_clone(self) -> "Bitmap":
         """Shallow snapshot sharing Container objects with this bitmap.
         O(container count), not O(bytes): the handoff a background
-        snapshot takes under a brief mutex hold. After the clone, this
-        (live) bitmap copies any shared container before mutating it, so
-        the clone observes a frozen point-in-time state while writes
-        proceed. The clone itself must be treated as read-only."""
+        snapshot or a migration base stream takes under a brief mutex
+        hold. After the clone, this (live) bitmap copies any shared
+        container before mutating it, so the clone observes a frozen
+        point-in-time state while writes proceed. The clone itself must
+        be treated as read-only, and the caller must pair the clone with
+        cow_release() once done serializing. Clones stack: a second
+        clone re-arms every current key (copied-then-mutated containers
+        included — the new clone references the current objects), and
+        protection drops only when the LAST clone releases."""
         b = Bitmap()
         items = list(self.containers.items())
         for k, c in items:
             b.containers[k] = c
-        self._cow = {k for k, _ in items}
+        keys = {k for k, _ in items}
+        self._cow = keys if self._cow is None else (self._cow | keys)
+        self._cow_refs += 1
         return b
+
+    def cow_release(self) -> None:
+        """Drop one cow_clone()'s copy-on-write protection. Must be
+        called under the owning fragment's mutex (like cow_clone)."""
+        self._cow_refs = max(0, self._cow_refs - 1)
+        if self._cow_refs == 0:
+            self._cow = None
 
     # ------------------------------------------------------------------ basic
 
@@ -1186,47 +1204,7 @@ class Bitmap:
         # that tear and also truncates (reported via truncated_bytes;
         # anti-entropy repairs the difference from a replica).
         op_start = ops_offset
-        while ops_offset < len(data):
-            remaining = len(data) - ops_offset
-            if data[ops_offset] == OP_BULK:
-                if remaining < BULK_MIN_SIZE:
-                    break  # incomplete trailing record
-                _, n_add, n_rem = _BULK_HEADER.unpack_from(data, ops_offset)
-                size = _BULK_HEADER.size + 8 * (n_add + n_rem) + 4
-                if size > remaining:
-                    break  # torn final append (see caveat above)
-                body_end = ops_offset + size - 4
-                chk = struct.unpack_from("<I", data, body_end)[0]
-                if chk != zlib.crc32(bytes(data[ops_offset:body_end])):
-                    if size < remaining:
-                        raise CorruptFragmentError(
-                            "bulk op checksum failure mid-log (not a torn "
-                            "tail)", offset=ops_offset)
-                    break  # corrupt FINAL record: a torn append
-                off = ops_offset + _BULK_HEADER.size
-                adds = np.frombuffer(data, dtype="<u8", count=n_add,
-                                     offset=off)
-                rems = np.frombuffer(data, dtype="<u8", count=n_rem,
-                                     offset=off + 8 * n_add)
-                b.add_many(adds.astype(np.uint64))
-                b.remove_many(rems.astype(np.uint64))
-                b.op_n += 1
-                ops_offset += size
-                continue
-            if remaining < OP_SIZE:
-                break  # incomplete trailing record
-            try:
-                op = parse_op(data, ops_offset)
-            except CorruptFragmentError:
-                if remaining > OP_SIZE:
-                    raise CorruptFragmentError(
-                        "op checksum failure mid-log (not a torn tail)",
-                        offset=ops_offset,
-                    )
-                break  # corrupt FINAL record: a torn append
-            b.apply_op(*op)
-            b.op_n += 1
-            ops_offset += OP_SIZE
+        ops_offset = _apply_op_stream(b, data, ops_offset)
         b.valid_len = ops_offset
         b.truncated_bytes = len(data) - ops_offset
         b.ops_bytes = ops_offset - op_start
@@ -1285,6 +1263,71 @@ def encode_bulk_op(adds=None, removes=None) -> bytes:
         removes if removes is not None else (), dtype="<u8")
     body = _BULK_HEADER.pack(OP_BULK, len(a), len(r)) + a.tobytes() + r.tobytes()
     return body + struct.pack("<I", zlib.crc32(body))
+
+
+def _apply_op_stream(b: "Bitmap", data, ops_offset: int) -> int:
+    """THE WAL-record replayer, shared by from_buffer's op-log tail and
+    migration catch-up streams (cluster/rebalance.py) so the two paths
+    cannot drift on record framing. Applies point + bulk records starting
+    at `ops_offset`, returns the offset of the first byte NOT applied
+    (end of data, or an incomplete/checksum-failing FINAL record — the
+    torn-append case). A bad record with MORE data beyond it is bit rot,
+    not a tear, and raises."""
+    while ops_offset < len(data):
+        remaining = len(data) - ops_offset
+        if data[ops_offset] == OP_BULK:
+            if remaining < BULK_MIN_SIZE:
+                break  # incomplete trailing record
+            _, n_add, n_rem = _BULK_HEADER.unpack_from(data, ops_offset)
+            size = _BULK_HEADER.size + 8 * (n_add + n_rem) + 4
+            if size > remaining:
+                break  # torn final append (see the caveat in from_buffer)
+            body_end = ops_offset + size - 4
+            chk = struct.unpack_from("<I", data, body_end)[0]
+            if chk != zlib.crc32(bytes(data[ops_offset:body_end])):
+                if size < remaining:
+                    raise CorruptFragmentError(
+                        "bulk op checksum failure mid-log (not a torn "
+                        "tail)", offset=ops_offset)
+                break  # corrupt FINAL record: a torn append
+            off = ops_offset + _BULK_HEADER.size
+            adds = np.frombuffer(data, dtype="<u8", count=n_add,
+                                 offset=off)
+            rems = np.frombuffer(data, dtype="<u8", count=n_rem,
+                                 offset=off + 8 * n_add)
+            b.add_many(adds.astype(np.uint64))
+            b.remove_many(rems.astype(np.uint64))
+            b.op_n += 1
+            ops_offset += size
+            continue
+        if remaining < OP_SIZE:
+            break  # incomplete trailing record
+        try:
+            op = parse_op(data, ops_offset)
+        except CorruptFragmentError:
+            if remaining > OP_SIZE:
+                raise CorruptFragmentError(
+                    "op checksum failure mid-log (not a torn tail)",
+                    offset=ops_offset,
+                )
+            break  # corrupt FINAL record: a torn append
+        b.apply_op(*op)
+        b.op_n += 1
+        ops_offset += OP_SIZE
+    return ops_offset
+
+
+def replay_ops(b: "Bitmap", data: bytes) -> None:
+    """Apply a SHIPPED run of WAL records (a migration catch-up tail) to
+    `b`. Unlike a local reopen — where a torn FINAL record is an expected
+    crash artifact — a stream that doesn't parse whole is a transport or
+    sender fault: raise so the receiver restarts rather than silently
+    installing a partial tail."""
+    end = _apply_op_stream(b, data, 0)
+    if end != len(data):
+        raise CorruptFragmentError(
+            f"torn migration op stream: {len(data) - end} trailing bytes "
+            "unparseable", offset=end)
 
 
 def parse_op(data: bytes, offset: int = 0) -> Tuple[int, int]:

@@ -27,6 +27,7 @@ except AttributeError:
 
 import subprocess
 import threading
+import time
 
 import pytest
 
@@ -78,6 +79,73 @@ class FakeClock:
 @pytest.fixture
 def fake_clock():
     return FakeClock()
+
+
+@pytest.fixture(autouse=True)
+def _release_engines(thread_leak_guard):
+    """Close every ShardedQueryEngine a test constructs (directly or via
+    a lazy Executor.engine) at teardown: the cold-gather pool's workers
+    are non-daemon, and tests build engines ad hoc in dozens of places —
+    tracking construction here keeps the thread-leak guard honest
+    without threading an engine fixture through every test signature.
+    Depending on the guard fixture orders finalization: engines release
+    FIRST, the guard's census runs after. Double-close is safe
+    (pool.shutdown is idempotent), so tests/servers that already close
+    their executors are unaffected."""
+    from pilosa_tpu.parallel import engine as engine_mod
+
+    created = []
+    orig_init = engine_mod.ShardedQueryEngine.__init__
+
+    def tracking_init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        created.append(self)
+
+    engine_mod.ShardedQueryEngine.__init__ = tracking_init
+    try:
+        yield
+    finally:
+        engine_mod.ShardedQueryEngine.__init__ = orig_init
+        for e in created:
+            try:
+                e.close()
+            except Exception:
+                pass
+
+
+@pytest.fixture(autouse=True)
+def thread_leak_guard(request):
+    """Fail any test that leaves NON-DAEMON background threads running at
+    teardown (un-shut-down executor/hedge/import pools, migration stream
+    workers) — with the thread census printed so the leak is attributable
+    to a thread, not a flaky downstream test. Daemon threads are exempt:
+    the process can exit through them, and monitors/snapshotters are
+    daemonized by design. A short grace lets threads that were ALREADY
+    shutting down (pool.shutdown(wait=False)) finish their exit."""
+    before = {t.ident for t in threading.enumerate()}
+    yield
+
+    def leaked():
+        return [
+            t for t in threading.enumerate()
+            if t.ident not in before and not t.daemon and t.is_alive()
+        ]
+
+    remaining = leaked()
+    deadline = time.monotonic() + 5.0
+    while remaining and time.monotonic() < deadline:
+        for t in remaining:
+            t.join(timeout=0.2)
+        remaining = leaked()
+    if remaining:
+        census = "\n".join(
+            f"  - {t.name} (ident={t.ident}, daemon={t.daemon})"
+            for t in remaining
+        )
+        pytest.fail(
+            f"test leaked {len(remaining)} non-daemon background "
+            f"thread(s) still running at teardown:\n{census}"
+        )
 
 
 @pytest.fixture(scope="session")

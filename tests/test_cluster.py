@@ -211,6 +211,100 @@ def test_fragment_sources_diff():
     assert all(s["sourceNodeID"] in ("a", "b") for s in sources["c"])
 
 
+_SCHEMA_1F = [
+    {"name": "i", "fields": [{"name": "f", "views": [{"name": "standard"}]}]}
+]
+
+
+def test_fragment_sources_node_removal():
+    """Removing a node: every shard it exclusively held is fetched by its
+    new owner, sourced from an OLD owner (the leaver stays reachable as a
+    source during the job)."""
+    old_nodes = [Node(id="a", uri="a"), Node(id="b", uri="b"),
+                 Node(id="c", uri="c")]
+    new_nodes = old_nodes[:2]
+    old = Cluster(node=old_nodes[0], nodes=old_nodes, hasher=ModHasher())
+    new = Cluster(node=old_nodes[0], nodes=new_nodes, hasher=ModHasher())
+    sources = fragment_sources(old, new, _SCHEMA_1F, {"i": 7})
+    fetched = {s["shard"] for lst in sources.values() for s in lst}
+    changed = {
+        sh for sh in range(8)
+        if [n.id for n in old.shard_nodes("i", sh)]
+        != [n.id for n in new.shard_nodes("i", sh)]
+    }
+    assert fetched == changed
+    for lst in sources.values():
+        for s in lst:
+            assert s["sourceNodeID"] in {
+                n.id for n in old.shard_nodes("i", s["shard"])}
+
+
+def test_fragment_sources_replica_overlap():
+    """replica_n=2: a node that already holds a shard as a replica in the
+    OLD placement never re-fetches it in the new one."""
+    old_nodes = [Node(id="a", uri="a"), Node(id="b", uri="b")]
+    new_nodes = old_nodes + [Node(id="c", uri="c")]
+    old = Cluster(node=old_nodes[0], nodes=old_nodes, replica_n=2,
+                  hasher=ModHasher())
+    new = Cluster(node=old_nodes[0], nodes=new_nodes, replica_n=2,
+                  hasher=ModHasher())
+    sources = fragment_sources(old, new, _SCHEMA_1F, {"i": 7})
+    for node_id, lst in sources.items():
+        for s in lst:
+            old_owners = {n.id for n in old.shard_nodes("i", s["shard"])}
+            # Only genuinely-NEW owners appear; an overlap owner is never
+            # instructed to fetch what it already has.
+            assert node_id not in old_owners
+            assert s["sourceNodeID"] in old_owners
+
+
+def test_fragment_sources_noop_resize_is_empty():
+    """Identical topologies produce zero instructions for every node."""
+    nodes = [Node(id="a", uri="a"), Node(id="b", uri="b")]
+    old = Cluster(node=nodes[0], nodes=nodes, hasher=ModHasher())
+    new = Cluster(node=nodes[0], nodes=list(nodes), hasher=ModHasher())
+    sources = fragment_sources(old, new, _SCHEMA_1F, {"i": 9})
+    assert all(lst == [] for lst in sources.values())
+
+
+def test_fragment_sources_empty_old_owners():
+    """A shard with NO old owner (empty prior cluster) is skipped instead
+    of raising IndexError on old_owners[0]."""
+    nodes = [Node(id="a", uri="a")]
+    old = Cluster(node=nodes[0], nodes=nodes, hasher=ModHasher())
+    old.nodes = []  # constructor refuses an empty list; force it
+    new = Cluster(node=nodes[0], nodes=nodes, hasher=ModHasher())
+    sources = fragment_sources(old, new, _SCHEMA_1F, {"i": 3})
+    assert sources == {"a": []}
+
+
+def test_fragment_sources_prefers_healthy_source():
+    """source_ok steers selection to a healthy replica; when it rejects
+    every old owner, placement order wins (a degraded source beats no
+    source)."""
+    old_nodes = [Node(id="a", uri="a"), Node(id="b", uri="b")]
+    new_nodes = old_nodes + [Node(id="c", uri="c")]
+    old = Cluster(node=old_nodes[0], nodes=old_nodes, replica_n=2,
+                  hasher=ModHasher())
+    new = Cluster(node=old_nodes[0], nodes=new_nodes, replica_n=2,
+                  hasher=ModHasher())
+
+    sources = fragment_sources(
+        old, new, _SCHEMA_1F, {"i": 7},
+        source_ok=lambda nid, *frag: nid != "a")
+    entries = [s for lst in sources.values() for s in lst]
+    assert entries
+    assert all(s["sourceNodeID"] == "b" for s in entries)
+
+    sources = fragment_sources(
+        old, new, _SCHEMA_1F, {"i": 7},
+        source_ok=lambda nid, *frag: False)
+    for lst in sources.values():
+        for s in lst:
+            # Fallback: first old owner in placement order.
+            assert s["sourceNodeID"] == old.shard_nodes("i", s["shard"])[0].id
+
+
 def test_resize_add_node_moves_data(tmp_path):
     """Add a third node to a 2-node cluster with data; moved shards must be
     queryable from the new topology (reference ClusterResize_AddNode)."""

@@ -23,7 +23,9 @@ def holder(tmp_path):
 
 @pytest.fixture
 def ex(holder):
-    return Executor(holder, translate_store=TranslateStore().open(), workers=0)
+    e = Executor(holder, translate_store=TranslateStore().open(), workers=0)
+    yield e
+    e.close()  # releases the engine's gather pool (thread-leak guard)
 
 
 def setup_index(holder, name="i", keys=False):
@@ -414,7 +416,10 @@ def test_durability_across_reopen(holder, ex, tmp_path):
     ex.execute("i", f"Set({SHARD_WIDTH + 7}, f=10)")
     holder.reopen()
     ex2 = Executor(holder, translate_store=TranslateStore().open(), workers=0)
-    assert list(ex2.execute("i", "Row(f=10)")[0].columns()) == [3, SHARD_WIDTH + 7]
+    try:
+        assert list(ex2.execute("i", "Row(f=10)")[0].columns()) == [3, SHARD_WIDTH + 7]
+    finally:
+        ex2.close()
 
 
 def test_topn_chunked_matches_single_chunk(holder, ex, monkeypatch):

@@ -26,7 +26,9 @@ def holder(tmp_path):
 
 @pytest.fixture
 def ex(holder):
-    return Executor(holder, translate_store=TranslateStore().open(), workers=0)
+    e = Executor(holder, translate_store=TranslateStore().open(), workers=0)
+    yield e
+    e.close()  # releases the engine's gather pool (thread-leak guard)
 
 
 def set_bit(holder, index, field, row, col):
