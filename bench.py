@@ -1766,6 +1766,205 @@ def bench_open():
     }
 
 
+# --------------------------------------------- tiered plane storage stanza
+
+
+def bench_tier():
+    """Tiered eviction vs drop-and-regather under HBM pressure
+    (docs/tiered-storage.md): the working set is ~3x the leaf-cache
+    budget, so every sweep over the planes evicts. With the tier manager
+    on, an eviction demotes the plane container-compressed into host RAM
+    and the next touch decodes it back (one streaming pass) instead of
+    re-walking every shard's live containers — the qps gap between the
+    two modes is the price of drop-and-regather.
+
+    Reports per-mode qps/p50/p99 plus promotion/demotion counts, asserts
+    zero full regathers after the warm-up sweep in tiered mode (every
+    re-touch must be an HBM hit or a tier promotion), and proves writes
+    that stay within the delta bound fold on promotion instead of forcing
+    a regather."""
+    from pilosa_tpu.constants import SHARD_WIDTH, WORDS_PER_ROW
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.parallel import EngineConfig
+    from pilosa_tpu.parallel.engine import ShardedQueryEngine
+    from pilosa_tpu.pql.parser import parse
+    from pilosa_tpu.tier import TierConfig
+
+    n_rows, n_shards, per_row, sweeps, batch = (
+        (18, 2, 512, 4, 6) if SMOKE else (96, 4, 4096, 3, 8))
+    plane_bytes = n_shards * WORDS_PER_ROW * 4
+    budget = n_rows * plane_bytes // 3  # working set ~3x the HBM budget
+
+    holder = Holder(None)
+    holder.open()
+    idx = holder.create_index("tier")
+    fld = idx.create_field("f")
+    rng = np.random.default_rng(17)
+    rows, cols = [], []
+    for row in range(n_rows):
+        for shard in range(n_shards):
+            c = rng.choice(SHARD_WIDTH, size=per_row, replace=False)
+            rows.append(np.full(per_row, row, dtype=np.uint64))
+            cols.append(c.astype(np.uint64) + np.uint64(shard * SHARD_WIDTH))
+    fld.import_bits(np.concatenate(rows), np.concatenate(cols))
+
+    shards = list(range(n_shards))
+    calls = {r: parse(f"Row(f={r})").calls[0] for r in range(n_rows)}
+
+    out = {
+        "planes": n_rows,
+        "plane_mib": round(plane_bytes / 2**20, 2),
+        "budget_mib": round(budget / 2**20, 2),
+    }
+
+    def run_mode(tier_on: bool):
+        # Prefetch off during the measured sweeps: both modes pay their
+        # misses on the query path, so the comparison isolates what a
+        # miss COSTS (the prefetcher's job of hiding misses entirely is
+        # measured separately below).
+        tc = TierConfig(
+            host_bytes=(1 << 30) if tier_on else 0, disk_bytes=0,
+            prefetch_interval=0)
+        # Memos off (env wins over config): a repeat count is answered
+        # host-side by the result memo with zero gathers, which is a
+        # different serving path (measured in the SCALE stanza) — this
+        # stanza measures what a leaf-cache MISS costs under pressure.
+        old_memo = os.environ.get("PILOSA_MEMO_ENTRIES")
+        os.environ["PILOSA_MEMO_ENTRIES"] = "0"
+        try:
+            engine = ShardedQueryEngine(
+                holder,
+                config=EngineConfig(leaf_cache_bytes=budget,
+                                    stack_cache_bytes=budget),
+                tier_config=tc)
+        finally:
+            if old_memo is None:
+                os.environ.pop("PILOSA_MEMO_ENTRIES", None)
+            else:
+                os.environ["PILOSA_MEMO_ENTRIES"] = old_memo
+        # Batched counts (the engine's serving bread and butter): B rows
+        # per dispatch, so per-query host assembly — the cost the tier
+        # changes — is what the comparison measures, not the fixed
+        # dispatch/transfer tax both modes pay identically.
+        def sweep_groups(s):
+            # Rotate the batch composition per sweep: same planes, fresh
+            # batch/stack/memo keys, so every sweep pays real gathers
+            # (a repeated identical batch is answered by the host result
+            # memo — a different serving path than the one under test).
+            rot = [(r + s) % n_rows for r in range(n_rows)]
+            return [rot[g : g + batch] for g in range(0, n_rows, batch)]
+
+        mode = {}
+        try:
+            # Warm-up sweep: every plane gathered cold once; the budget
+            # forces ~2/3 of them out (demoted or dropped).
+            for grp in sweep_groups(sweeps):
+                np.asarray(engine.count_batch(
+                    "tier", [calls[r] for r in grp], shards))
+            if tier_on:
+                engine.tier.drain()
+            base = dict(engine.counters)
+            lat = []
+            t0 = time.perf_counter()
+            for s in range(sweeps):
+                for grp in sweep_groups(s):
+                    t1 = time.perf_counter()
+                    np.asarray(engine.count_batch(
+                        "tier", [calls[r] for r in grp], shards))
+                    lat.append(time.perf_counter() - t1)
+                if tier_on:
+                    # Settle the demote queue between sweeps (inside the
+                    # measured window: the worker's serialization is part
+                    # of the tier's total cost) so the zero-full-regather
+                    # assertion is deterministic, not a race.
+                    engine.tier.drain()
+            dt = time.perf_counter() - t0
+            lat.sort()
+            mode["qps"] = round(len(lat) * batch / dt, 1)
+            mode["p50_ms"] = round(lat[len(lat) // 2] * 1e3, 2)
+            mode["p99_ms"] = round(lat[int(len(lat) * 0.99)] * 1e3, 2)
+            mode["hbm_hits"] = engine.counters["leaf_hits"] - base["leaf_hits"]
+            mode["full_regathers"] = (
+                engine.counters["leaf_misses"] - base["leaf_misses"])
+            if tier_on:
+                mode["tier_promotions"] = (
+                    engine.counters["leaf_tier_hits"]
+                    - base["leaf_tier_hits"])
+                snap = engine.tier.snapshot()
+                mode["demotions"] = snap["demotions_host"]
+                mode["host_mib"] = round(snap["host_bytes"] / 2**20, 3)
+                mode["compression_x"] = round(
+                    snap["host_entries"] * plane_bytes
+                    / max(snap["host_bytes"], 1), 1)
+                # Delta-fold proof: a small write to every currently
+                # demoted plane, then re-touch — the journal folds at
+                # promotion time, so STILL zero full regathers.
+                writes = 0
+                pre = dict(engine.counters)
+                for wr in range(0, n_rows, 7):
+                    fld.set_bit(wr, wr * 31 % SHARD_WIDTH)
+                    writes += 1
+                engine.tier.drain()
+                for r in range(n_rows):
+                    np.asarray(engine.count_async("tier", calls[r], shards))
+                mode["writes_folded"] = writes
+                mode["post_write_full_regathers"] = (
+                    engine.counters["leaf_misses"] - pre["leaf_misses"])
+                mode["delta_folds"] = engine.tier.snapshot()["delta_folds"]
+        finally:
+            engine.close()
+        return mode
+
+    out["tiered"] = run_mode(True)
+    out["drop_regather"] = run_mode(False)
+    out["qps_ratio"] = round(
+        out["tiered"]["qps"] / max(out["drop_regather"]["qps"], 1e-9), 2)
+
+    # Predictive prefetch: a roomy engine (the whole working set fits)
+    # whose planes all start DEMOTED — the traffic signal marks the index
+    # hot, and the prefetcher promotes into free headroom before any
+    # query touches a plane, so the serving sweep afterwards must see
+    # zero query-path promotions or regathers for the prefetched keys.
+    from pilosa_tpu.parallel.engine import Leaf
+
+    tc = TierConfig(host_bytes=1 << 30, disk_bytes=0,
+                    prefetch_interval=0.02, prefetch_batch=16)
+    traffic = {"n": 1}
+    engine = ShardedQueryEngine(
+        holder, config=EngineConfig(leaf_cache_bytes=4 * n_rows * plane_bytes),
+        tier_config=tc, traffic_fn=lambda: {"tier": traffic["n"]})
+    try:
+        for r in range(n_rows):
+            engine.tier.demote(("tier", Leaf("f", "standard", r),
+                               tuple(shards)))
+        engine.tier.drain()
+        deadline = time.time() + (10 if SMOKE else 30)
+        while time.time() < deadline:
+            traffic["n"] += 1  # the index stays "hot" every sweep
+            if engine.tier.snapshot()["prefetch_promotions"] >= n_rows:
+                break
+            time.sleep(0.02)
+        snap = engine.tier.snapshot()
+        base = dict(engine.counters)
+        t0 = time.perf_counter()
+        for r in range(n_rows):
+            np.asarray(engine.count_async("tier", calls[r], shards))
+        dt = time.perf_counter() - t0
+        out["prefetch"] = {
+            "promotions": snap["prefetch_promotions"],
+            "serving_qps": round(n_rows / dt, 1),
+            "query_path_promotions": (
+                engine.counters["leaf_tier_hits"] - base["leaf_tier_hits"]),
+            "query_path_regathers": (
+                engine.counters["leaf_misses"] - base["leaf_misses"]),
+            "hits": engine.counters["leaf_hits"] - base["leaf_hits"],
+        }
+    finally:
+        engine.close()
+    holder.close()
+    return out
+
+
 # Every optional stanza, in run order. THE registry: main() runs exactly
 # these, the FINAL JSON line carries a key per entry (lowercased), and
 # tests/test_bench_smoke.py asserts every name is present — a stanza
@@ -1783,6 +1982,7 @@ STANZAS = (
     ("MIXED", bench_mixed),
     ("FAULT", bench_fault),
     ("REBALANCE", bench_rebalance),
+    ("TIER", bench_tier),
     ("TOPN_BSI", bench_topn_bsi),
     ("TIME_RANGE", bench_time_range),
 )

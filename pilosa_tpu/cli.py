@@ -162,6 +162,38 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    type=int,
                    help="threads for cold-path per-shard plane gathers "
                         "(0 = auto)")
+    p.add_argument("--engine-leaf-cache-bytes", dest="engine_leaf_cache_bytes",
+                   type=int,
+                   help="device leaf-plane cache budget in bytes "
+                        "(0 = tier hbm-bytes split, else platform default)")
+    p.add_argument("--engine-stack-cache-bytes",
+                   dest="engine_stack_cache_bytes", type=int,
+                   help="device stacked-tensor cache budget in bytes "
+                        "(0 = tier hbm-bytes split, else platform default)")
+    p.add_argument("--engine-memo-entries", dest="engine_memo_entries",
+                   type=int,
+                   help="host count-memo entry budget (0 = default)")
+    p.add_argument("--engine-aux-memo-entries",
+                   dest="engine_aux_memo_entries", type=int,
+                   help="host composite-result memo entry budget "
+                        "(0 = default)")
+    p.add_argument("--tier-hbm-bytes", dest="tier_hbm_bytes", type=int,
+                   help="combined device-cache budget split across the "
+                        "leaf/stack caches (0 = platform default)")
+    p.add_argument("--tier-host-bytes", dest="tier_host_bytes", type=int,
+                   help="budget for container-compressed demoted planes "
+                        "held in host RAM (0 disables the host tier)")
+    p.add_argument("--tier-disk-bytes", dest="tier_disk_bytes", type=int,
+                   help="budget for compressed planes spilled to disk "
+                        "(0 disables the disk tier)")
+    p.add_argument("--tier-disk-path", dest="tier_disk_path",
+                   help="spill directory (default <data-dir>/tier-spill)")
+    p.add_argument("--tier-prefetch-interval", dest="tier_prefetch_interval",
+                   type=float,
+                   help="seconds between prefetch sweeps re-promoting "
+                        "demoted planes of hot indexes (0 disables)")
+    p.add_argument("--tier-prefetch-batch", dest="tier_prefetch_batch",
+                   type=int, help="max planes promoted per prefetch sweep")
     p.add_argument("--translation-primary-url", dest="translation_primary_url")
     p.add_argument("--tls-certificate", dest="tls_certificate")
     p.add_argument("--tls-certificate-key", dest="tls_certificate_key")

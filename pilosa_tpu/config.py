@@ -80,6 +80,11 @@ from .ingest import IngestConfig  # noqa: E402
 # light). See docs/engine-caches.md.
 from .parallel import EngineConfig  # noqa: E402
 
+# And for [tier]: the HBM ↔ host-RAM ↔ disk residency budgets live with
+# the tier manager (pilosa_tpu/tier/, jax-free). See
+# docs/tiered-storage.md.
+from .tier import TierConfig  # noqa: E402
+
 # And for [resilience]: the peer fault-tolerance knobs (circuit breakers,
 # retry budget, hedged reads) live with the health registry they govern
 # (cluster/health.py, stdlib-only). See docs/fault-tolerance.md.
@@ -130,6 +135,7 @@ class Config:
     storage: StorageConfig = field(default_factory=StorageConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
+    tier: TierConfig = field(default_factory=TierConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     rebalance: RebalanceConfig = field(default_factory=RebalanceConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
@@ -234,6 +240,23 @@ class Config:
             "delta-journal-ops", self.engine.delta_journal_ops)
         self.engine.gather_workers = e.get(
             "gather-workers", self.engine.gather_workers)
+        self.engine.leaf_cache_bytes = e.get(
+            "leaf-cache-bytes", self.engine.leaf_cache_bytes)
+        self.engine.stack_cache_bytes = e.get(
+            "stack-cache-bytes", self.engine.stack_cache_bytes)
+        self.engine.memo_entries = e.get(
+            "memo-entries", self.engine.memo_entries)
+        self.engine.aux_memo_entries = e.get(
+            "aux-memo-entries", self.engine.aux_memo_entries)
+        ti = d.get("tier", {})
+        self.tier.hbm_bytes = ti.get("hbm-bytes", self.tier.hbm_bytes)
+        self.tier.host_bytes = ti.get("host-bytes", self.tier.host_bytes)
+        self.tier.disk_bytes = ti.get("disk-bytes", self.tier.disk_bytes)
+        self.tier.disk_path = ti.get("disk-path", self.tier.disk_path)
+        self.tier.prefetch_interval = ti.get(
+            "prefetch-interval", self.tier.prefetch_interval)
+        self.tier.prefetch_batch = ti.get(
+            "prefetch-batch", self.tier.prefetch_batch)
         m = d.get("metric", {})
         self.metric.service = m.get("service", self.metric.service)
         self.metric.host = m.get("host", self.metric.host)
@@ -347,10 +370,25 @@ class Config:
             ("delta_max_fraction", "ENGINE_DELTA_MAX_FRACTION", float),
             ("delta_journal_ops", "ENGINE_DELTA_JOURNAL_OPS", int),
             ("gather_workers", "ENGINE_GATHER_WORKERS", int),
+            ("leaf_cache_bytes", "ENGINE_LEAF_CACHE_BYTES", int),
+            ("stack_cache_bytes", "ENGINE_STACK_CACHE_BYTES", int),
+            ("memo_entries", "ENGINE_MEMO_ENTRIES", int),
+            ("aux_memo_entries", "ENGINE_AUX_MEMO_ENTRIES", int),
         ]:
             v = env(name, cast)
             if v is not None:
                 setattr(self.engine, attr, v)
+        for attr, name, cast in [
+            ("hbm_bytes", "TIER_HBM_BYTES", int),
+            ("host_bytes", "TIER_HOST_BYTES", int),
+            ("disk_bytes", "TIER_DISK_BYTES", int),
+            ("disk_path", "TIER_DISK_PATH", str),
+            ("prefetch_interval", "TIER_PREFETCH_INTERVAL", float),
+            ("prefetch_batch", "TIER_PREFETCH_BATCH", int),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.tier, attr, v)
         v = env("TRANSLATION_PRIMARY_URL", str)
         if v is not None:
             self.translation.primary_url = v
@@ -421,6 +459,16 @@ class Config:
             "engine_delta_max_fraction": ("engine", "delta_max_fraction"),
             "engine_delta_journal_ops": ("engine", "delta_journal_ops"),
             "engine_gather_workers": ("engine", "gather_workers"),
+            "engine_leaf_cache_bytes": ("engine", "leaf_cache_bytes"),
+            "engine_stack_cache_bytes": ("engine", "stack_cache_bytes"),
+            "engine_memo_entries": ("engine", "memo_entries"),
+            "engine_aux_memo_entries": ("engine", "aux_memo_entries"),
+            "tier_hbm_bytes": ("tier", "hbm_bytes"),
+            "tier_host_bytes": ("tier", "host_bytes"),
+            "tier_disk_bytes": ("tier", "disk_bytes"),
+            "tier_disk_path": ("tier", "disk_path"),
+            "tier_prefetch_interval": ("tier", "prefetch_interval"),
+            "tier_prefetch_batch": ("tier", "prefetch_batch"),
             "translation_primary_url": ("translation", "primary_url"),
             "tls_certificate": ("tls", "certificate_path"),
             "tls_certificate_key": ("tls", "certificate_key_path"),
@@ -514,6 +562,18 @@ class Config:
             f"delta-max-fraction = {self.engine.delta_max_fraction}",
             f"delta-journal-ops = {self.engine.delta_journal_ops}",
             f"gather-workers = {self.engine.gather_workers}",
+            f"leaf-cache-bytes = {self.engine.leaf_cache_bytes}",
+            f"stack-cache-bytes = {self.engine.stack_cache_bytes}",
+            f"memo-entries = {self.engine.memo_entries}",
+            f"aux-memo-entries = {self.engine.aux_memo_entries}",
+            "",
+            "[tier]",
+            f"hbm-bytes = {self.tier.hbm_bytes}",
+            f"host-bytes = {self.tier.host_bytes}",
+            f"disk-bytes = {self.tier.disk_bytes}",
+            f"disk-path = {fmt(self.tier.disk_path)}",
+            f"prefetch-interval = {self.tier.prefetch_interval}",
+            f"prefetch-batch = {self.tier.prefetch_batch}",
             "",
             "[metric]",
             f"service = {fmt(self.metric.service)}",
@@ -571,6 +631,7 @@ class Config:
             storage_config=self.storage.validate(),
             ingest_config=self.ingest.validate(),
             engine_config=self.engine,
+            tier_config=self.tier.validate(),
             resilience_config=self.resilience.validate(),
             rebalance_config=self.rebalance.validate(),
         )

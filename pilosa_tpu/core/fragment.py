@@ -535,6 +535,27 @@ class Fragment:
         base = (row_id * SHARD_WIDTH) >> 6
         return self.storage.words64(np.asarray(w64, dtype=np.int64) + base)
 
+    def row_compressed(self, row_id: int) -> Tuple[bytes, Tuple[int, int]]:
+        """Container-compressed snapshot of one row plane (roaring bytes,
+        containers rebased to key 0) plus the (incarnation, generation)
+        fingerprint it is exact at — the tier manager's demotion read
+        (docs/tiered-storage.md). The container copies happen under the
+        fragment mutex so a racing writer cannot tear a form transition
+        mid-copy (the same hazard cow_clone guards for snapshots); the
+        O(row bytes) serialization itself runs off-lock."""
+        start = row_id * SHARD_WIDTH
+        end = start + SHARD_WIDTH
+        with self._mu:
+            if SHARD_WIDTH % (1 << 16):
+                # Exotic shard widths aren't container-aligned; rebuild
+                # from values (correct, slower — tests only).
+                vals = self.storage.slice_range(start, end)
+                sub = Bitmap(vals - np.uint64(start) if len(vals) else None)
+            else:
+                sub = self.storage.offset_range(0, start, end)
+            fp = (self.incarnation, self.generation)
+        return sub.to_bytes(), fp
+
     def _check_moved(self) -> None:
         """Write gate for migrated-away fragments: raise BEFORE any
         mutation so a re-routed retry applies the write exactly once, on

@@ -98,6 +98,24 @@ class DiagnosticsCollector:
             info["engineStackDeltaHits"] = c.get("stack_delta_hits", 0)
             info["engineDeltaBytes"] = c.get("delta_bytes", 0)
             info["engineFullRefreshBytes"] = c.get("full_refresh_bytes", 0)
+            # Tiered-storage shape: HBM misses answered by the compressed
+            # host/disk tiers vs full cold regathers, and how the
+            # predictive prefetch is doing. tierPromotions ≫ leafMisses
+            # means HBM pressure is being absorbed by the tiers.
+            info["engineLeafTierHits"] = c.get("leaf_tier_hits", 0)
+            info["engineLeafMisses"] = c.get("leaf_misses", 0)
+            if engine.tier is not None:
+                snap = engine.tier.snapshot()
+                info["tierHostBytes"] = snap.get("host_bytes", 0)
+                info["tierHostEntries"] = snap.get("host_entries", 0)
+                info["tierDiskBytes"] = snap.get("disk_bytes", 0)
+                info["tierDemotions"] = (snap.get("demotions_host", 0)
+                                         + snap.get("demotions_disk", 0))
+                info["tierPromotions"] = (snap.get("promotions_host", 0)
+                                          + snap.get("promotions_disk", 0))
+                info["tierDeltaFolds"] = snap.get("delta_folds", 0)
+                info["tierPrefetchHits"] = snap.get("prefetch_hits", 0)
+                info["tierCorruptSpills"] = snap.get("corrupt_spills", 0)
         # Ingest/snapshot shape: WAL bytes awaiting a snapshot and how the
         # background snapshotter is keeping up. A deployment whose
         # ingestWalBytes climbs while snapshot counters stall is ingesting

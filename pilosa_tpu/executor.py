@@ -119,6 +119,7 @@ class Executor:
         max_writes_per_request: int = MAX_WRITES_PER_REQUEST,
         workers: int = 8,
         engine_config=None,
+        tier_config=None,
     ):
         from .cluster.node import Cluster
 
@@ -126,6 +127,11 @@ class Executor:
         # Device-engine knobs (parallel.EngineConfig); held here because
         # the engine itself is constructed lazily on first device use.
         self.engine_config = engine_config
+        # [tier] residency budgets (tier.TierConfig) + the scheduler's
+        # per-index traffic signal for the tier prefetcher; the server
+        # wires traffic_fn before any query can build the engine.
+        self.tier_config = tier_config
+        self.tier_traffic_fn = None
         self.cluster = cluster or Cluster()
         self.client = client
         self.translate_store = translate_store
@@ -163,7 +169,9 @@ class Executor:
             from .parallel.engine import ShardedQueryEngine
 
             self._engine = ShardedQueryEngine(
-                self.holder, config=self.engine_config)
+                self.holder, config=self.engine_config,
+                tier_config=self.tier_config,
+                traffic_fn=self.tier_traffic_fn)
         return self._engine
 
     def close(self) -> None:

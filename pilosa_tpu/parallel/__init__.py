@@ -28,3 +28,15 @@ class EngineConfig:
     delta_max_fraction: float = 0.25
     delta_journal_ops: int = 4096
     gather_workers: int = 0
+    # Cache budgets (0 = auto). Auto means: the legacy env override
+    # (PILOSA_LEAF_CACHE_BYTES / PILOSA_STACK_CACHE_BYTES /
+    # PILOSA_MEMO_ENTRIES / PILOSA_AUX_MEMO_ENTRIES) if set, else the
+    # [tier] hbm-bytes split (byte budgets only), else the platform
+    # default. A nonzero config value loses only to the legacy env var —
+    # env stays the per-process override, as before these were
+    # configurable at all. Effective values surface in /debug/vars
+    # (engine_budgets).
+    leaf_cache_bytes: int = 0
+    stack_cache_bytes: int = 0
+    memo_entries: int = 0
+    aux_memo_entries: int = 0

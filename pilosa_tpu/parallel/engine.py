@@ -226,7 +226,8 @@ class _Compiler:
 
 
 class ShardedQueryEngine:
-    def __init__(self, holder, mesh=None, config: Optional[EngineConfig] = None):
+    def __init__(self, holder, mesh=None, config: Optional[EngineConfig] = None,
+                 tier_config=None, traffic_fn=None):
         self.holder = holder
         self.mesh = mesh if mesh is not None else default_mesh()
         if config is None:
@@ -242,7 +243,20 @@ class ShardedQueryEngine:
                 gather_workers=int(os.environ.get(
                     "PILOSA_TPU_ENGINE_GATHER_WORKERS",
                     EngineConfig.gather_workers)),
+                leaf_cache_bytes=int(os.environ.get(
+                    "PILOSA_TPU_ENGINE_LEAF_CACHE_BYTES", 0)),
+                stack_cache_bytes=int(os.environ.get(
+                    "PILOSA_TPU_ENGINE_STACK_CACHE_BYTES", 0)),
+                memo_entries=int(os.environ.get(
+                    "PILOSA_TPU_ENGINE_MEMO_ENTRIES", 0)),
+                aux_memo_entries=int(os.environ.get(
+                    "PILOSA_TPU_ENGINE_AUX_MEMO_ENTRIES", 0)),
             )
+        if tier_config is None:
+            # Same env-only fallback for the [tier] section.
+            from ..tier import TierConfig
+
+            tier_config = TierConfig.from_env()
         # Delta-refresh budget: a stale resident tensor is refreshed by a
         # scattered (indices, values) upload only while the changed 32-bit
         # words stay under this fraction of the tensor; 0 disables deltas.
@@ -266,10 +280,23 @@ class ShardedQueryEngine:
         # on every ranked-cache TopN.
         on_accel = self.mesh.devices.flat[0].platform in ("tpu", "axon")
         default_budget = (3 << 30) if on_accel else (1 << 29)
-        self._leaf_budget = int(
-            os.environ.get("PILOSA_LEAF_CACHE_BYTES", default_budget))
-        self._stack_budget = int(
-            os.environ.get("PILOSA_STACK_CACHE_BYTES", default_budget))
+        if tier_config.hbm_bytes > 0:
+            # [tier] hbm-bytes is the COMBINED device-cache budget, split
+            # evenly; an explicit [engine] budget or legacy env var for
+            # one cache still wins for that cache.
+            default_budget = max(1, int(tier_config.hbm_bytes) // 2)
+
+        def budget(env_name: str, cfg_val: int, default: int) -> int:
+            v = os.environ.get(env_name)
+            if v is not None:
+                return int(v)
+            return int(cfg_val) if cfg_val > 0 else default
+
+        self._leaf_budget = budget(
+            "PILOSA_LEAF_CACHE_BYTES", config.leaf_cache_bytes, default_budget)
+        self._stack_budget = budget(
+            "PILOSA_STACK_CACHE_BYTES", config.stack_cache_bytes,
+            default_budget)
         self._stack_jit: Optional[Callable] = None
         self._count_fns: Dict[Tuple, Callable] = {}
         self._bitmap_fns: Dict[Tuple, Callable] = {}
@@ -291,13 +318,25 @@ class ShardedQueryEngine:
         # which on a remote-runtime link is ~70ms -> ~50us. Invalidated by
         # the same per-fragment generation counters as the leaf cache.
         self._memo: Dict[Tuple, Tuple[Tuple, int]] = {}
-        self._memo_budget = int(os.environ.get("PILOSA_MEMO_ENTRIES", 8192))
+        self._memo_budget = budget(
+            "PILOSA_MEMO_ENTRIES", config.memo_entries, 8192)
         # Composite-result memo (TopN per-shard matrices, BSI val counts):
         # a repeat TopN pays zero device round trips — phase-1 AND the
         # phase-2 refetch hit here. Bounded by entries (values are small
         # (R,S) host arrays); shares the memo hit/miss counters.
         self._aux_memo: Dict[Tuple, Tuple[Tuple, object]] = {}
-        self._aux_budget = int(os.environ.get("PILOSA_AUX_MEMO_ENTRIES", 512))
+        self._aux_budget = budget(
+            "PILOSA_AUX_MEMO_ENTRIES", config.aux_memo_entries, 512)
+        # Effective cache bounds after env > config > tier > default
+        # resolution, surfaced verbatim in /debug/vars (engine_budgets) so
+        # a deployment can SEE what its knobs resolved to.
+        self.budgets = {
+            "leaf_cache_bytes": self._leaf_budget,
+            "stack_cache_bytes": self._stack_budget,
+            "memo_entries": self._memo_budget,
+            "aux_memo_entries": self._aux_budget,
+            "fn_cache_entries": self._fn_budget,
+        }
         # Observable cache behavior (hit rate / eviction pressure) for
         # /debug/vars and the HBM-budget bench stanza.
         self.counters = {
@@ -316,7 +355,34 @@ class ShardedQueryEngine:
             # correctness under mixed read/write traffic.
             "leaf_delta_hits": 0, "stack_delta_hits": 0,
             "delta_bytes": 0, "full_refresh_bytes": 0,
+            # Tiered-storage accounting: an HBM miss answered by
+            # decompressing a demoted plane from the host/disk tier
+            # (leaf_tier_hits) instead of a cold container walk
+            # (leaf_misses). Memo/aux evictions close the observability
+            # gap the leaf/stack caches never had.
+            "leaf_tier_hits": 0, "tier_promote_bytes": 0,
+            "memo_evictions": 0, "aux_evictions": 0,
+            # _byte_cache_put's explicit oversized-entry policy: an entry
+            # bigger than its whole budget is admitted ALONE (everything
+            # else evicts) and counted here — rejecting it would make the
+            # largest plane permanently uncacheable (regather per query),
+            # strictly worse than holding it.
+            "oversized_admits": 0,
         }
+        # Tier manager (tier/manager.py): owns the host-RAM + disk tiers
+        # below the device caches. Leaf evictions demote through it and
+        # cold gathers probe it before paying the container walk.
+        self.tier = None
+        if tier_config.enabled():
+            from ..tier.manager import TierManager
+
+            self.tier = TierManager(
+                self.holder, tier_config, traffic_fn=traffic_fn)
+            self.tier.bind(
+                promote_fn=self._tier_promote_key,
+                headroom_fn=self._hbm_headroom,
+                resident_fn=self._tier_resident,
+            )
 
     def stack_generation(self, index: str) -> int:
         """O(1) write epoch of an index's resident leaf stacks (bumped by
@@ -333,11 +399,55 @@ class ShardedQueryEngine:
     def close(self) -> None:
         """Release host-side serving resources (the cold-gather thread
         pool — its workers are non-daemon, so an embedder that opens and
-        closes executors repeatedly would otherwise leak them)."""
+        closes executors repeatedly would otherwise leak them). The tier
+        manager stops FIRST so its prefetch thread can't race the pool
+        shutdown with a promotion."""
+        if self.tier is not None:
+            self.tier.close()
         with self._lock:
             pool, self._gather_pool = self._gather_pool, None
         if pool is not None:
             pool.shutdown(wait=False)
+
+    # ----------------------------------------------------- tier integration
+    #
+    # The leaf cache is the TOP tier of the three-tier plane hierarchy
+    # (docs/tiered-storage.md): evicted planes demote into the manager's
+    # compressed host tier instead of vanishing, cold gathers probe the
+    # manager before paying the container walk, and the manager's prefetch
+    # thread re-promotes demoted planes of hot indexes through the hooks
+    # below. All three hooks are engine-lock-cheap; the manager never
+    # calls them while holding its own lock with ours taken.
+
+    def _tier_promote_key(self, key) -> bool:
+        """Prefetch hook: make `key` HBM-resident via the normal gather
+        path (which consumes the tier entry and installs the plane)."""
+        index, leaf, shards = key
+        try:
+            self._gather_leaf(index, leaf, shards)
+            return True
+        except Exception:
+            return False
+
+    def _hbm_headroom(self) -> int:
+        with self._lock:
+            return self._leaf_budget - self._leaf_bytes
+
+    def _tier_resident(self, key) -> bool:
+        with self._lock:
+            return key in self._leaf_cache
+
+    def _demote_keys(self, keys) -> None:
+        """Demote freshly-evicted leaf planes into the host tier. Runs
+        OUTSIDE the engine lock (demotion takes fragment mutexes and
+        serializes containers — far too heavy for the cache lock)."""
+        if not keys or self.tier is None:
+            return
+        for key in keys:
+            try:
+                self.tier.demote(key)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ caches
     #
@@ -416,15 +526,30 @@ class ShardedQueryEngine:
         return fn
 
     def _byte_cache_put(self, cache: Dict, key, entry: Tuple, budget: int,
-                        used: int, evict_counter: str = "") -> int:
+                        used: int, evict_counter: str = "",
+                        evicted: Optional[List] = None) -> int:
         """Insert (fingerprint, array) at MRU and evict LRU entries past the
         byte budget; returns the updated used-bytes counter. Caller holds
-        self._lock."""
+        self._lock.
+
+        Oversized-entry policy (explicit, tested): an entry whose payload
+        exceeds the WHOLE budget is admitted alone — every other entry
+        evicts and the insert is counted in `oversized_admits`. The
+        alternative (reject-and-count) would make the largest plane
+        permanently uncacheable and re-gathered per query, strictly worse
+        than briefly over-committing; `used` stays exact either way so the
+        next insert immediately evicts back under budget.
+
+        `evicted` (when a list) collects the evicted KEYS so the caller
+        can demote those planes into the tier manager after releasing the
+        lock — eviction is demotion, not loss (docs/tiered-storage.md)."""
         prev = cache.pop(key, None)
         if prev is not None:
             used -= prev[1].nbytes
         used += entry[1].nbytes
         cache[key] = entry
+        if entry[1].nbytes > budget:
+            self.counters["oversized_admits"] += 1
         while used > budget and len(cache) > 1:
             old_key = next(iter(cache))
             if old_key == key:
@@ -432,6 +557,8 @@ class ShardedQueryEngine:
             used -= cache.pop(old_key)[1].nbytes
             if evict_counter:
                 self.counters[evict_counter] += 1
+            if evicted is not None:
+                evicted.append(old_key)
         return used
 
     @property
@@ -471,12 +598,17 @@ class ShardedQueryEngine:
                 if cached is not None and cached[0] == fingerprint:
                     self._leaf_cache[key] = self._leaf_cache.pop(key)  # LRU touch
                     self.counters["leaf_hits"] += 1
-                    return cached[1]
-            return None
+                    hit = cached[1]
+                else:
+                    return None
+            if self.tier is not None and self.tier.has_prefetched():
+                self.tier.note_hbm_hit(key)
+            return hit
 
         arr = self._gate(("leaf", key), probe)
         if arr is not None:
             return arr
+        evicted: List = []
         try:
             # Stale resident entry: try the delta path first — upload only
             # the words the writes changed instead of re-walking every
@@ -484,20 +616,37 @@ class ShardedQueryEngine:
             with self._lock:
                 stale = self._leaf_cache.get(key)
             if stale is not None:
-                arr = self._leaf_delta(key, leaf.row, stale, frags, fingerprint)
+                arr = self._leaf_delta(key, leaf.row, stale, frags,
+                                       fingerprint, evicted)
                 if arr is not None:
                     return arr
-            buf = self._host_gather(frags, leaf.row, s_padded)
+            # Demoted plane? Decode the compressed host/disk-tier image
+            # (journal deltas folded) instead of walking every shard's
+            # live containers.
+            buf = None
+            if self.tier is not None:
+                buf = self.tier.promote(key, frags, fingerprint, s_padded)
+            tier_hit = buf is not None
+            if buf is None:
+                buf = self._host_gather(frags, leaf.row, s_padded)
             arr = jax.device_put(buf, shard_sharding(self.mesh, 2))
             with self._lock:
-                self.counters["leaf_misses"] += 1
-                self.counters["full_refresh_bytes"] += buf.nbytes
+                if tier_hit:
+                    self.counters["leaf_tier_hits"] += 1
+                    self.counters["tier_promote_bytes"] += buf.nbytes
+                else:
+                    self.counters["leaf_misses"] += 1
+                    self.counters["full_refresh_bytes"] += buf.nbytes
                 self._leaf_bytes = self._byte_cache_put(
                     self._leaf_cache, key, (fingerprint, arr),
                     self._leaf_budget, self._leaf_bytes, "leaf_evictions",
+                    evicted,
                 )
         finally:
             self._release(("leaf", key))
+            # Evicted planes demote off-lock whichever path installed the
+            # fresh entry (full gather, tier promote, or delta refresh).
+            self._demote_keys(evicted)
         return arr
 
     # ------------------------------------------------------- cold gather
@@ -609,9 +758,10 @@ class ShardedQueryEngine:
             return arrays
         return [np.concatenate([a, np.repeat(a[:1], npad - n)]) for a in arrays]
 
-    def _leaf_delta(self, key, row: int, stale, frags, fingerprint):
+    def _leaf_delta(self, key, row: int, stale, frags, fingerprint,
+                    evicted: Optional[List] = None):
         """Refresh a stale cached (S, W) leaf; None = caller must
-        full-regather."""
+        full-regather. `evicted` collects evicted keys for demotion."""
         old_fp, arr = stale
         if self._delta_max_fraction <= 0 or len(old_fp) != len(fingerprint):
             return None
@@ -647,6 +797,7 @@ class ShardedQueryEngine:
             self._leaf_bytes = self._byte_cache_put(
                 self._leaf_cache, key, (fingerprint, new_arr),
                 self._leaf_budget, self._leaf_bytes, "leaf_evictions",
+                evicted,
             )
         return new_arr
 
@@ -836,6 +987,7 @@ class ShardedQueryEngine:
             self._memo[key] = (fp, epoch, count)
             while len(self._memo) > self._memo_budget:
                 self._memo.pop(next(iter(self._memo)))
+                self.counters["memo_evictions"] += 1
 
     def _aux_probe(self, key, fp):
         """Generation-checked memo for composite results (TopN count
@@ -856,6 +1008,7 @@ class ShardedQueryEngine:
             self._aux_memo[key] = (fp, value)
             while len(self._aux_memo) > self._aux_budget:
                 self._aux_memo.pop(next(iter(self._aux_memo)))
+                self.counters["aux_evictions"] += 1
 
     # -------------------------------------------------------------- queries
 

@@ -93,6 +93,15 @@ class QueryScheduler:
             "admitted": 0, "shed": 0, "deadline_exceeded": 0,
             "admitted_interactive": 0, "admitted_batch": 0,
         }
+        # Per-index query traffic — the tier manager's prefetch signal
+        # (docs/tiered-storage.md): a demoted plane whose index is taking
+        # queries RIGHT NOW is worth re-promoting before the next query
+        # pays the miss. Monotonic counts; consumers diff between reads.
+        # Bounded so a schema-churning tenant can't grow it without limit
+        # (evicting the coldest entry only forgets history, never breaks
+        # correctness — prefetch is advisory).
+        self._index_traffic: Dict[str, int] = {}
+        self._index_traffic_max = 1024
 
     # ---------------------------------------------------------- admission
 
@@ -208,6 +217,24 @@ class QueryScheduler:
         err.counted = True  # already in scheduler stats; API must not recount
         raise err
 
+    def note_index(self, index: str) -> None:
+        """Record one query against `index` (called by the API on every
+        admitted or forwarded query). Eviction is by RECENCY (the dict is
+        kept in last-touch order), not by count: a lifetime-count victim
+        rule would perpetually evict newly-created busy indexes while
+        idle-but-historically-hot ones squatted the table."""
+        with self._lock:
+            t = self._index_traffic
+            n = t.pop(index, None)
+            if n is None and len(t) >= self._index_traffic_max:
+                t.pop(next(iter(t)), None)  # least recently touched
+            t[index] = (n or 0) + 1
+
+    def index_traffic(self) -> Dict[str, int]:
+        """Snapshot of per-index query counts (monotonic; diff to rate)."""
+        with self._lock:
+            return dict(self._index_traffic)
+
     def note_deadline_exceeded(self) -> None:
         """Record an expiry detected downstream (executor map/reduce or the
         remote fan-out) so every abort is visible in scheduler stats."""
@@ -225,4 +252,5 @@ class QueryScheduler:
             out["waiting"] = dict(self._waiting_by)
             out["running"] = dict(self._running)
             out["remote_inflight"] = self._remote_inflight
+            out["index_traffic"] = dict(self._index_traffic)
         return out

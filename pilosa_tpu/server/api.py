@@ -125,6 +125,10 @@ class API:
             return self.executor.execute(index, query, shards=shards, opt=opt)
         from ..sched import CLASS_INTERACTIVE, DeadlineExceededError
 
+        # Per-index traffic signal for the tier manager's prefetch
+        # (docs/tiered-storage.md): forwarded sub-queries count too —
+        # on a data node they ARE this index's serving traffic.
+        sched.note_index(index)
         try:
             if remote:
                 # Remote (forwarded) sub-queries are fan-out fragments of

@@ -618,6 +618,19 @@ class Handler:
                 for k in ("leaf_delta_hits", "stack_delta_hits",
                           "delta_bytes", "full_refresh_bytes")
             }
+            # Effective cache bounds after env > [engine] > [tier] >
+            # platform-default resolution — the knobs are spread across
+            # three config surfaces, so a deployment must be able to SEE
+            # what they resolved to without reading the resolution code.
+            out["engine_budgets"] = dict(engine.budgets)
+            # Tiered-storage health (docs/tiered-storage.md): per-tier
+            # bytes/entries plus promotion/demotion/prefetch/delta-fold
+            # counters — the on-call question under HBM pressure is "are
+            # evictions coming back as sub-ms promotions or full
+            # regathers" (leaf_tier_hits vs leaf_misses above answers the
+            # other half).
+            if engine.tier is not None:
+                out["tier"] = engine.tier.snapshot()
         # Scheduler lifecycle metrics: queue depth, admit/shed/deadline
         # counts, and the micro-batcher's launch/coalesce counters (wait
         # time and batch-size histograms live in the stats timings above).

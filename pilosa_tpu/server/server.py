@@ -64,6 +64,7 @@ class Server:
         storage_config=None,
         ingest_config=None,
         engine_config=None,
+        tier_config=None,
         join_addr: Optional[str] = None,
         allowed_origins: Optional[List[str]] = None,
         tls_certificate: Optional[str] = None,
@@ -179,6 +180,13 @@ class Server:
         from ..ingest import IngestConfig
 
         self.ingest_config = (ingest_config or IngestConfig()).validate()
+        # [tier] residency budgets for the engine's plane tier manager
+        # (docs/tiered-storage.md). A disk tier with no explicit path
+        # spills under the data dir; a pathless (in-memory) server keeps
+        # the disk tier off rather than spilling somewhere surprising.
+        if tier_config is not None and data_dir and (
+                tier_config.disk_bytes > 0 and not tier_config.disk_path):
+            tier_config.disk_path = os.path.join(data_dir, "tier-spill")
         self.executor = Executor(
             self.holder,
             cluster=self.cluster,
@@ -187,6 +195,7 @@ class Server:
             max_writes_per_request=max_writes_per_request,
             workers=executor_workers,
             engine_config=engine_config,
+            tier_config=tier_config,
         )
         # Writes racing a live-rebalance cutover re-route/wait up to this
         # long for the commit broadcast before failing clean.
@@ -201,6 +210,11 @@ class Server:
 
         sched_cfg = scheduler_config or SchedulerConfig()
         self.scheduler = QueryScheduler(sched_cfg, stats=self.stats)
+        # Traffic signal for the tier manager's predictive prefetch: the
+        # scheduler's per-index query counters tell the prefetcher which
+        # indexes are hot RIGHT NOW. Wired before any query can build the
+        # engine (the executor's engine property reads it lazily).
+        self.executor.tier_traffic_fn = self.scheduler.index_traffic
         self.batcher = MicroBatcher(
             lambda: self.executor.engine,
             window=sched_cfg.batch_window,

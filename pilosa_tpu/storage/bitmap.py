@@ -1248,6 +1248,125 @@ class Bitmap:
         return problems
 
 
+# --------------------------------------------------- plane-section codec
+#
+# The tier manager (tier/manager.py) keeps demoted row planes container-
+# compressed in host RAM and on disk. The encoded form IS the roaring
+# serialization above (Bitmap.to_bytes of the row's containers rebased to
+# key 0, via offset_range), so a spilled plane and a fragment file share
+# one format and one set of corruption checks. Decode is a dedicated
+# streaming pass rather than from_buffer + range_words: promotion is
+# serving-path work, and skipping Container/Bitmap object construction —
+# one row-wide bool scatter + ONE packbits for every sparse container
+# instead of a packbits per container — is what lets a host-tier
+# re-promotion undercut the cold per-container walk.
+
+
+def decode_plane_words(data, n_words: int) -> np.ndarray:
+    """Decode a plane-section roaring buffer (to_bytes of a bitmap whose
+    containers were rebased to key 0) into a dense little-endian uint64
+    word array of exactly `n_words` words. Containers beyond the plane,
+    unknown types, or out-of-bounds payloads raise CorruptFragmentError
+    (the tier manager treats that as "regather, don't error"). Trailing
+    bytes past the container region are ignored — section images carry
+    no op log."""
+    out = np.zeros(n_words, dtype=np.uint64)
+    if len(data) < HEADER_BASE_SIZE:
+        raise CorruptFragmentError("plane section too small", offset=0)
+    magic = struct.unpack_from("<H", data, 0)[0]
+    if magic != MAGIC_NUMBER:
+        raise CorruptFragmentError(
+            f"invalid plane section, magic number {magic}", offset=0)
+    key_n = struct.unpack_from("<I", data, 4)[0]
+    pos = HEADER_BASE_SIZE
+    try:
+        headers = [struct.unpack_from("<QHH", data, pos + 12 * i)
+                   for i in range(key_n)]
+        offsets = struct.unpack_from(
+            f"<{key_n}I", data, pos + 12 * key_n) if key_n else ()
+    except struct.error as e:
+        raise CorruptFragmentError(
+            f"truncated plane section headers: {e}", offset=pos) from e
+    one = np.uint64(1)
+    full = np.uint64(0xFFFFFFFFFFFFFFFF)
+    # Array containers accumulate global bit positions and scatter in ONE
+    # vectorized pass at the end: container keys are serialized ascending
+    # and each array's values are sorted, so the concatenation is globally
+    # sorted and the per-word OR groups are contiguous — one reduceat
+    # replaces per-container python/numpy round trips (which dominate at
+    # typical container sizes) and never materializes per-bit booleans.
+    arr_positions: list = []
+    for (key, typ, _n1), off in zip(headers, offsets):
+        base = int(key) * BITMAP_N
+        if base < 0 or base >= n_words:
+            raise CorruptFragmentError(
+                f"plane section container key {key} out of plane",
+                offset=off)
+        # A container may extend past a sub-container plane (exotic
+        # SHARD_WIDTH < 2^16, tests only): its in-plane words decode, and
+        # bits beyond the plane are corruption (the encoder never writes
+        # them), checked per form below.
+        n_copy = min(BITMAP_N, n_words - base)
+        if typ == CONTAINER_BITMAP:
+            if off + 8 * BITMAP_N > len(data):
+                raise CorruptFragmentError(
+                    f"bitset payload out of bounds at key {key}", offset=off)
+            words = np.frombuffer(data, dtype="<u8", count=BITMAP_N,
+                                  offset=off)
+            if n_copy < BITMAP_N and words[n_copy:].any():
+                raise CorruptFragmentError(
+                    f"bitset bits beyond plane at key {key}", offset=off)
+            out[base : base + n_copy] = words[:n_copy]
+        elif typ == CONTAINER_ARRAY:
+            n = _n1 + 1
+            if off + 2 * n > len(data):
+                raise CorruptFragmentError(
+                    f"array payload out of bounds at key {key}", offset=off)
+            arr = np.frombuffer(data, dtype="<u2", count=n, offset=off)
+            arr_positions.append((base << 6) + arr.astype(np.int64))
+        elif typ == CONTAINER_RUN:
+            if off + 2 > len(data):
+                raise CorruptFragmentError(
+                    f"run header out of bounds at key {key}", offset=off)
+            run_n = struct.unpack_from("<H", data, off)[0]
+            if off + 2 + 4 * run_n > len(data):
+                raise CorruptFragmentError(
+                    f"run payload out of bounds at key {key}", offset=off)
+            runs = np.frombuffer(
+                data, dtype="<u2", count=2 * run_n, offset=off + 2
+            ).reshape(run_n, 2)
+            for s, l in runs:
+                s, l = int(s), int(l)
+                if l < s:
+                    raise CorruptFragmentError(
+                        f"inverted run at key {key}", offset=off)
+                if (base << 6) + l >= n_words * 64:
+                    raise CorruptFragmentError(
+                        f"run beyond plane at key {key}", offset=off)
+                w0, w1 = base + (s >> 6), base + (l >> 6)
+                m0 = (full << np.uint64(s & 63)) & full
+                m1 = full >> np.uint64(63 - (l & 63))
+                if w0 == w1:
+                    out[w0] |= m0 & m1
+                else:
+                    out[w0] |= m0
+                    out[w0 + 1 : w1] = full
+                    out[w1] |= m1
+        else:
+            raise CorruptFragmentError(
+                f"unknown container type {typ}", offset=off)
+    if arr_positions:
+        glob = (arr_positions[0] if len(arr_positions) == 1
+                else np.concatenate(arr_positions))
+        if int(glob[-1]) >= n_words * 64:  # sorted: the max bit position
+            raise CorruptFragmentError("array bits beyond plane", offset=0)
+        words = glob >> 6
+        vals = one << (glob.astype(np.uint64) & np.uint64(63))
+        starts = np.concatenate(([0], np.flatnonzero(np.diff(words)) + 1))
+        out[words[starts]] |= np.bitwise_or.reduceat(vals, starts)
+    return out
+
+
 def encode_op(typ: int, value: int) -> bytes:
     body = struct.pack("<BQ", typ, value)
     return body + struct.pack("<I", fnv32a(body))

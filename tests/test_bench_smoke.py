@@ -78,6 +78,16 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     fault = detail["fault"]
     assert fault["recovered"], fault
     assert fault["recovery_s"] < 30, fault
+    # The TIER stanza is the tiered-storage acceptance metric: with the
+    # working set ~3x the HBM budget, tiered eviction must beat
+    # drop-and-regather on qps, with ZERO full regathers once the tiers
+    # are warm — including after writes that stay within the delta bound
+    # (the journal folds on promotion instead of poisoning to a walk).
+    tier = detail["tier"]
+    assert tier["tiered"]["qps"] > tier["drop_regather"]["qps"], tier
+    assert tier["tiered"]["full_regathers"] == 0, tier
+    assert tier["tiered"]["post_write_full_regathers"] == 0, tier
+    assert tier["prefetch"]["promotions"] > 0, tier
 
     # BENCH_OUT got the same line atomically.
     assert json.loads(out_path.read_text())["detail"]["mixed"]["delta_ok"]
