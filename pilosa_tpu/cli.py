@@ -27,8 +27,8 @@ if _plat:
         import jax
 
         jax.config.update("jax_platforms", _plat)
-    except Exception:
-        pass
+    except ImportError:
+        pass  # no jax on this box: CPU-only config tooling still works
 
 
 def _add_config_flags(p: argparse.ArgumentParser) -> None:

@@ -172,6 +172,26 @@ class Cluster:
             self.migrated = set()
             self._advance_epoch(epoch)
 
+    def adopt_topology_if_ahead(self, new_nodes: List[Node],
+                                epoch: Optional[int]) -> bool:
+        """Anti-entropy adoption (member monitor): atomically re-validate
+        and commit a peer's post-job topology. The monitor's decision to
+        adopt runs OUTSIDE the routing lock, so a rebalance-begin landing
+        between the decision and the commit would otherwise have its
+        next_nodes/migrated overrides wiped by the late commit — routing
+        cut-over shards back to their old owners until the job's complete
+        broadcast. Returns False when the adoption lost the race (a begin
+        installed overrides, or the epoch caught up meanwhile)."""
+        with self._routing_mu:
+            if (self.next_nodes is not None
+                    or epoch is None
+                    or epoch <= self.routing_epoch):
+                return False
+            self.nodes = sorted(new_nodes, key=lambda n: n.id)
+            self.migrated = set()
+            self.routing_epoch = epoch
+            return True
+
     def abort_rebalance(self, committed=None) -> bool:
         """Drop a live rebalance. Returns True when routing fully
         reverted to the old topology; False when cutovers had already

@@ -785,6 +785,11 @@ class RebalanceJob:
         # messages so a re-sent instruction for a resumed job is not
         # swallowed by the receivers' duplicate-delivery dedupe.
         self.attempt = attempt
+        # Set by _complete: a straggler shard_committed racing completion
+        # must not re-persist the checkpoint after _clear_state removed it
+        # (a resurrected stale checkpoint makes a restarted coordinator
+        # spuriously resume a finished job).
+        self.finalized = False
         self.new_nodes = new_nodes
         # node_id -> [{index, shard, fragments: [{field, view,
         # sourceNodeID}]}] — sources are PER FRAGMENT (source_ok may
@@ -1085,6 +1090,7 @@ class RebalanceCoordinator:
             if self.job is not job:
                 return
             self.job = None
+            job.finalized = True
         server = self.server
         cluster = server.cluster
         old_nodes = list(cluster.nodes)
@@ -1114,6 +1120,15 @@ class RebalanceCoordinator:
             server.logger.info(
                 "rebalance %s: holder cleaner removed %d fragments",
                 job.id, len(removed))
+        # Thaw fragments still frozen after the cleaner: with replicas>=2
+        # the coordinator can be a migration SOURCE for a shard it keeps
+        # owning as a replica — the cleaner keeps that fragment, and a
+        # lingering _moved flag would leave it permanently write-dead.
+        # (Followers do the same in _adopt_committed_topology.)
+        thawed = server.migration_source.unfreeze(keep=())
+        if thawed:
+            server.logger.info(
+                "rebalance %s: thawed %d frozen fragments", job.id, thawed)
         server.logger.info("rebalance job %s complete: %d nodes, epoch %d",
                            job.id, len(cluster.nodes), cluster.routing_epoch)
 
@@ -1137,6 +1152,7 @@ class RebalanceCoordinator:
             committed={tuple(k) for k in job.committed})
         server.cluster.health.clear_copy_grace()
         if reverted:
+            job.finalized = True
             self._clear_state()
         else:
             # Cutovers already committed cannot be un-committed without a
@@ -1160,6 +1176,8 @@ class RebalanceCoordinator:
         if not path:
             return
         with self._persist_mu:
+            if job.finalized:
+                return
             with job.lock:
                 state = {
                     "jobID": job.id,
@@ -1170,15 +1188,22 @@ class RebalanceCoordinator:
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(state, f)
+            # pilint: allow-blocking(_persist_mu exists only to serialize this tiny checkpoint write; no query-path lock is held)
             os.replace(tmp, path)
 
     def _clear_state(self) -> None:
         path = self._state_path()
-        if path and os.path.exists(path):
-            try:
-                os.remove(path)
-            except OSError:
-                pass
+        if not path:
+            return
+        # Hold _persist_mu so an in-flight _persist finishes its write
+        # BEFORE the remove (and any later one sees job.finalized): the
+        # checkpoint cannot be resurrected after this returns.
+        with self._persist_mu:
+            if os.path.exists(path):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
 
     def _node_uri(self, job: RebalanceJob, node_id: str) -> str:
         for n in list(self.server.cluster.nodes) + list(job.new_nodes):

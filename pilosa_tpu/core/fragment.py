@@ -372,6 +372,7 @@ class Fragment:
                         and self.storage_config.fsync != FSYNC_NEVER):
                     # `batch` mode promises a sync at every close boundary.
                     self._wal.flush()
+                    # pilint: allow-blocking(close boundary: the mutex must pin the WAL open until its final sync lands)
                     os.fsync(self._wal.fileno())
                     self._unsynced_ops = 0
                 self._wal.close()
@@ -660,6 +661,7 @@ class Fragment:
                 # up to fsync-batch-ops-1 whole acked BATCHES in the page
                 # cache across a power loss. The amortization win was the
                 # removed O(fragment) file rewrite, not this fsync.
+                # pilint: allow-blocking(WAL durability is ordered with the mutation: the record must be on disk before the mutex releases the ack)
                 os.fsync(self._wal.fileno())
                 self._unsynced_ops = 0
         self.op_n += 1
@@ -667,10 +669,12 @@ class Fragment:
     def _fsync_policy(self) -> None:
         mode = self.storage_config.fsync
         if mode == FSYNC_ALWAYS:
+            # pilint: allow-blocking(fsync=always SELLS per-op durability under the mutex; that cost is the mode's contract, docs/durability.md)
             os.fsync(self._wal.fileno())
         elif mode != FSYNC_NEVER:
             self._unsynced_ops += 1
             if self._unsynced_ops >= self.storage_config.fsync_batch_ops:
+                # pilint: allow-blocking(batch-mode sync point: one fsync per N acked ops, ordered with the op it makes durable)
                 os.fsync(self._wal.fileno())
                 self._unsynced_ops = 0
 
@@ -1223,8 +1227,10 @@ class Fragment:
                         # inode empty/torn, losing every op the snapshot
                         # folded in.
                         f.flush()
+                        # pilint: allow-blocking(inline snapshot is the synchronous escape hatch — the off-lock path is snapshot_background)
                         os.fsync(f.fileno())
                 failpoints.fire("snapshot-rename")
+                # pilint: allow-blocking(inline snapshot: writers must not land ops between the serialized image and the rename)
                 os.replace(tmp, self.path)
                 if durable:
                     # Directory fsync: the rename itself must survive power
@@ -1233,6 +1239,7 @@ class Fragment:
                     # truncated away.
                     dfd = os.open(os.path.dirname(self.path), os.O_RDONLY)
                     try:
+                        # pilint: allow-blocking(inline snapshot: rename durability before the mutex releases)
                         os.fsync(dfd)
                     finally:
                         os.close(dfd)
@@ -1337,8 +1344,10 @@ class Fragment:
                         f.write(tail)
                         if durable:
                             f.flush()
+                            # pilint: allow-blocking(splice boundary: the WAL tail copied under the mutex is exactly what makes acked mid-snapshot writes durable)
                             os.fsync(f.fileno())
                 failpoints.fire("snapshot-rename")
+                # pilint: allow-blocking(rename must be atomic vs writers: an op landing between splice and rename would vanish from the new inode)
                 os.replace(tmp, self.path)
             except OSError:
                 # The original file (containers + full op log) is still the
@@ -1362,6 +1371,7 @@ class Fragment:
             if durable:
                 dfd = os.open(os.path.dirname(self.path), os.O_RDONLY)
                 try:
+                    # pilint: allow-blocking(the handle swap above re-pointed appends at the new inode; its rename durability must land before the mutex releases them)
                     os.fsync(dfd)
                 finally:
                     os.close(dfd)
@@ -1385,6 +1395,7 @@ class Fragment:
         with open(tmp, "wb") as f:
             f.write(struct.pack("<I", len(ids)))
             f.write(np.asarray(ids, dtype="<u8").tobytes())
+        # pilint: allow-blocking(close/snapshot boundary: the tiny TopN cache file must match the storage the mutex is pinning)
         os.replace(tmp, path)
 
     def _load_cache(self) -> None:

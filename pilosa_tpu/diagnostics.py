@@ -93,7 +93,10 @@ class DiagnosticsCollector:
         # be what first opens the device backend.
         engine = getattr(getattr(self.server, "executor", None), "_engine", None)
         if engine is not None:
-            c = engine.counters
+            # Locked snapshot, not a live dict read — same rule the
+            # /debug/vars handler follows (engine counters mutate under
+            # the engine lock on the serving path).
+            c = engine.snapshot()
             info["engineLeafDeltaHits"] = c.get("leaf_delta_hits", 0)
             info["engineStackDeltaHits"] = c.get("stack_delta_hits", 0)
             info["engineDeltaBytes"] = c.get("delta_bytes", 0)

@@ -83,6 +83,13 @@ class ExecOptions:
     # longer serves a requested shard, the request 409s so the sender
     # re-routes once — never an empty answer from a migrated/GC'd shard.
     epoch: Optional[int] = None
+    # LOCAL routing epoch captured by execute() before the stale-epoch
+    # gate (remote requests only): the post-gather re-check in _fan_out
+    # compares against this anchor, so a cutover committing anywhere in
+    # the window from gate to gather end — translation, or an earlier
+    # call of a multi-call query — is still detected. Anchoring inside
+    # _fan_out would capture a post-cutover epoch and miss the GC.
+    entry_epoch: Optional[int] = None
 
 
 @dataclass
@@ -218,6 +225,8 @@ class Executor:
                 f"too many writes: {len(query.write_calls())} > {self.max_writes_per_request}"
             )
         opt = opt or ExecOptions()
+        if opt.remote and opt.entry_epoch is None:
+            opt.entry_epoch = self.cluster.routing_epoch
 
         for call in query.calls:
             self._translate_call(index, idx, call)
@@ -227,18 +236,16 @@ class Executor:
             shards = list(range(idx.max_shard() + 1))
         shards = list(shards or [])
 
-        if (
-            opt.remote
-            and opt.epoch is not None
-            and opt.epoch < self.cluster.routing_epoch
-        ):
+        if opt.remote and (opt.epoch or 0) < self.cluster.routing_epoch:
             # The sender routed under an older placement than ours. Serving
             # a shard we no longer own would read a migrated (possibly
             # GC'd) fragment as empty — a silent hole. 409 instead; the
-            # sender re-routes once on refreshed placement.
+            # sender re-routes once on refreshed placement. An UNSTAMPED
+            # request counts as epoch 0: a sender that never saw the
+            # rebalance (lost the begin broadcast, or predates it) is the
+            # stalest possible router, not an exempt one.
             for shard in shards:
-                if not any(n.id == self.node.id
-                           for n in self.cluster.shard_nodes(index, shard)):
+                if not self._serves_shard(index, shard):
                     from .errors import StaleRoutingEpochError
 
                     raise StaleRoutingEpochError(
@@ -295,7 +302,11 @@ class Executor:
         try:
             if not c.active():
                 return False
-        except Exception:
+        except Exception as e:
+            # A probe failure routes the query to the HTTP fan-out; record
+            # it like every other refusal so a climbing fallback counter
+            # stays diagnosable.
+            self._collective_fallback(e)
             return False
         idx = self.holder.index(index)
         if idx is None:
@@ -378,6 +389,14 @@ class Executor:
 
         return self._fan_out(index, shards, c, opt, local_runner, reduce_fn)
 
+    def _serves_shard(self, index: str, shard: int) -> bool:
+        """True when this node serves (index, shard) under the CURRENT
+        routing view — the one predicate behind every stale-placement
+        gate (entry 409, receiver/local post-gather re-checks), kept in
+        one place so the epoch gates cannot drift apart."""
+        return any(n.id == self.node.id
+                   for n in self.cluster.shard_nodes(index, shard))
+
     def _fan_out(self, index, shards, c, opt, local_runner, reduce_fn):
         from .server.client import ClientError
 
@@ -386,13 +405,51 @@ class Executor:
         # coordinator chose them; re-deriving placement here would silently
         # drop shards whenever membership views differ mid-transition.
         if opt.remote:
-            return local_runner(list(shards)) if shards else None
+            if not shards:
+                return None
+            # Same mid-gather hazard the local batch below guards: the
+            # entry gate passed, but a cutover committing AFTER it can GC
+            # a moved shard's fragment mid-read so it reads as silently
+            # empty. Compare against the epoch execute() anchored BEFORE
+            # the gate (a snapshot taken here could already be
+            # post-cutover — translation and earlier calls of a
+            # multi-call query sit inside the window); a moved shard
+            # means the result may hold a hole, so 409 back to the
+            # sender for its free re-route.
+            epoch_at_entry = opt.entry_epoch
+            if epoch_at_entry is None:
+                epoch_at_entry = self.cluster.routing_epoch
+            v = local_runner(list(shards))
+            if self.cluster.routing_epoch != epoch_at_entry:
+                moved = [s for s in shards
+                         if not self._serves_shard(index, s)]
+                if moved:
+                    if self.holder.stats is not None:
+                        self.holder.stats.count("RemoteEpochReread", 1)
+                    from .errors import StaleRoutingEpochError
+
+                    raise StaleRoutingEpochError(
+                        f"shards {sorted(moved)} of {index} moved during "
+                        f"forwarded execution (epoch {epoch_at_entry} -> "
+                        f"{self.cluster.routing_epoch})"
+                    )
+            return v
 
         result = None
         failed: set = set()
         app_error = None
         pending = list(shards)
         while pending:
+            # Epoch BEFORE the placement read: the dispatch stamp and the
+            # local re-check below must reflect the routing decision, not
+            # the epoch at send time. Stamping the CURRENT epoch would let
+            # a cutover that lands between assign and dispatch defeat the
+            # receiver's stale-epoch gate (sender epoch caught up, stale
+            # placement rides along) — the receiver would serve a shard
+            # whose fragment it already GC'd as silently empty. An epoch
+            # that advances right after this read only causes a spurious
+            # 409 + free re-route, the safe direction.
+            epoch_at_assign = self.cluster.routing_epoch
             try:
                 local, remote = self._assign_shards(index, pending, exclude=failed)
             except PilosaError:
@@ -407,18 +464,36 @@ class Executor:
                 if opt.deadline is not None:
                     opt.deadline.check("local dispatch")
                 v = local_runner(local)
-                if v is not None:
+                moved = [] if self.cluster.routing_epoch == epoch_at_assign else [
+                    s for s in local if not self._serves_shard(index, s)
+                ]
+                if moved:
+                    # A live-rebalance cutover committed since this batch
+                    # was assigned: post-commit GC may have removed a
+                    # moved shard's fragment mid-read, so it read as
+                    # EMPTY — a silent hole, not an error. Discard this
+                    # batch and re-run it on refreshed placement (the
+                    # moved shards dispatch to their new owner next
+                    # round).
+                    if self.holder.stats is not None:
+                        self.holder.stats.count("LocalEpochReread", 1)
+                    pending.extend(local)
+                elif v is not None:
                     result = v if result is None else reduce_fn(result, v)
             for node_id, node_shards in remote.items():
                 if opt.remote:
                     continue  # remote calls are restricted to local shards
                 node = self.cluster.node_by_id(node_id)
                 kw = {}
-                if self.cluster.routing_epoch:
-                    # Stamp the routing epoch only once a rebalance has
-                    # ever advanced it — duck-typed test clients without
-                    # the parameter keep working untouched.
-                    kw["epoch"] = self.cluster.routing_epoch
+                if epoch_at_assign:
+                    # Stamp the epoch the placement decision was made
+                    # under (only once a rebalance has ever advanced it —
+                    # duck-typed test clients without the parameter keep
+                    # working untouched). See the capture above: the
+                    # current epoch could have caught up with the
+                    # receiver's after a mid-flight cutover, masking the
+                    # stale placement from its 409 gate.
+                    kw["epoch"] = epoch_at_assign
                 if opt.deadline is not None:
                     # Abort before the hop, and forward only the REMAINING
                     # budget so the peer never works past our cutoff. The
@@ -1506,7 +1581,7 @@ class Executor:
                 # time, not during translation.
                 try:
                     field_name = c.field_arg()
-                except Exception:
+                except QueryError:
                     field_name = None
                 row_key = field_name
             else:

@@ -33,7 +33,10 @@ from jax.experimental.pallas import tpu as pltpu
 def _on_tpu() -> bool:
     try:
         return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
+    except (RuntimeError, IndexError):
+        # RuntimeError: backend failed to initialize (no TPU runtime);
+        # IndexError: zero devices. Both mean "interpret mode" — anything
+        # else (a typo here, a jax API break) should surface loudly.
         return False
 
 

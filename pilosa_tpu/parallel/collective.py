@@ -64,7 +64,9 @@ def _dist_client():
         from jax._src import distributed as jdist
 
         return jdist.global_state.client
-    except Exception:  # pragma: no cover - defensive against jax internals
+    except (ImportError, AttributeError):  # pragma: no cover
+        # jax._src.distributed is private API: absent (ImportError) or
+        # reorganized (AttributeError) both read as "no runtime client".
         return None
 
 

@@ -368,6 +368,12 @@ class ShardedQueryEngine:
             # largest plane permanently uncacheable (regather per query),
             # strictly worse than holding it.
             "oversized_admits": 0,
+            # Background tier-hook failures (promotion gather / demotion
+            # capture) and fast-path compile-gate refusals: each swallows
+            # the exception by design (the caller has a correct fallback),
+            # so the COUNT is the only externally visible trace.
+            "tier_promote_errors": 0, "tier_demote_errors": 0,
+            "compile_gate_refusals": 0,
         }
         # Tier manager (tier/manager.py): owns the host-RAM + disk tiers
         # below the device caches. Leaf evictions demote through it and
@@ -395,6 +401,13 @@ class ShardedQueryEngine:
     def _count_dispatch(self) -> None:
         with self._lock:
             self.counters["count_dispatches"] += 1
+
+    def snapshot(self) -> dict:
+        """Wholesale counter export for /debug/vars (the `engine_cache`
+        group). Every key in self.counters is observable through here —
+        pilint R4 relies on that, so new counters need no wiring."""
+        with self._lock:
+            return dict(self.counters)
 
     def close(self) -> None:
         """Release host-side serving resources (the cold-gather thread
@@ -427,6 +440,8 @@ class ShardedQueryEngine:
             self._gather_leaf(index, leaf, shards)
             return True
         except Exception:
+            with self._lock:
+                self.counters["tier_promote_errors"] += 1
             return False
 
     def _hbm_headroom(self) -> int:
@@ -447,7 +462,10 @@ class ShardedQueryEngine:
             try:
                 self.tier.demote(key)
             except Exception:
-                pass
+                # The evicted plane simply stays cold (next read regathers
+                # from the fragments); the count is the trace.
+                with self._lock:
+                    self.counters["tier_demote_errors"] += 1
 
     # ------------------------------------------------------------ caches
     #
@@ -1613,6 +1631,12 @@ class ShardedQueryEngine:
                 return True
             return self._compile(index, call)
         except Exception:
+            # Any compile failure means "not fast-path" and the executor
+            # falls back to the reference walk — correct either way, but a
+            # climbing refusal count on a workload that should compile is
+            # the signal a gate bug would otherwise bury.
+            with self._lock:
+                self.counters["compile_gate_refusals"] += 1
             return False
 
     def _compile_check(self, call: Call) -> None:

@@ -421,6 +421,12 @@ class API:
             # clusters converge on every node's process index (the
             # collective plane's placement needs all of them).
             "processIdx": self.cluster.node.process_idx,
+            # Routing epoch + whether a live rebalance is in flight: a
+            # follower that lost the rebalance-complete broadcast (flaky
+            # link, all retries dropped) converges by adopting a peer's
+            # newer COMMITTED topology off the probe (_monitor_members).
+            "routingEpoch": self.cluster.routing_epoch,
+            "midRebalance": self.cluster.next_nodes is not None,
         }
 
     def info(self) -> dict:

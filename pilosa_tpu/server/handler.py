@@ -608,13 +608,16 @@ class Handler:
         engine = getattr(getattr(self.api, "executor", None), "_engine", None)
         if engine is not None:
             out = dict(out)
-            out["engine_cache"] = dict(engine.counters)
+            engine_cache = engine.snapshot()
+            out["engine_cache"] = engine_cache
             # Delta-refresh health pulled out as its own group: the on-call
             # question under mixed read/write traffic is "are writes
             # costing scattered KiB updates or full plane re-uploads", and
             # that should not require knowing the counter-dict layout.
+            # Derived from the one locked snapshot above so the two groups
+            # can never disagree within a single response.
             out["delta_refresh"] = {
-                k: engine.counters.get(k, 0)
+                k: engine_cache.get(k, 0)
                 for k in ("leaf_delta_hits", "stack_delta_hits",
                           "delta_bytes", "full_refresh_bytes")
             }
@@ -715,6 +718,7 @@ class Handler:
             os.makedirs(out, exist_ok=True)
             jax.profiler.start_trace(out)
             try:
+                # pilint: allow-blocking(the sleep IS the capture window; _profile_lock is a try-acquire busy flag — contenders 409 instead of waiting, so nothing can queue behind this)
                 time.sleep(seconds)
             finally:
                 jax.profiler.stop_trace()

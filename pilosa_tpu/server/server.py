@@ -508,6 +508,7 @@ class Server:
             )
             return
         with self._join_lock:
+            # pilint: allow-blocking(admission is a rare control-plane op: status/schema pushes stay under the lock so concurrent joins can't interleave topology broadcasts)
             self._admit_node(node)
 
     def _admit_node(self, node: Node) -> None:
@@ -798,6 +799,39 @@ class Server:
                         tgt = self.cluster.node_by_id(claimed.get("id"))
                         if tgt is not None:
                             tgt.is_coordinator = True
+                # Topology anti-entropy: the COORDINATOR on a newer
+                # routing epoch with NO rebalance in flight holds the
+                # authoritative post-job topology this node missed (the
+                # rebalance-complete/abort broadcasts are retried but not
+                # guaranteed — a brown-out can eat every attempt, leaving
+                # this follower mid-rebalance forever with un-GC'd
+                # fragments for shards it no longer owns). Adopt it with
+                # the full completion side effects. Coordinator-only — so
+                # this sits AFTER the claim merge above: a non-participant
+                # that merely saw a cutover-commit also shows (high epoch,
+                # midRebalance=False) but still carries the OLD nodes
+                # list; adopting that mid-job would wipe a participant's
+                # next_nodes/migrated overrides and route cut-over shards
+                # back to their old owners. Skip while coordinating a job
+                # ourselves: the coordinator's own commit drives the epoch
+                # forward, never a probe.
+                peer_epoch = status.get("routingEpoch")
+                if (
+                    peer_epoch is not None
+                    and peer_epoch > self.cluster.routing_epoch
+                    and not status.get("midRebalance")
+                    and node.is_coordinator
+                    and status.get("nodes")
+                    and not (self.rebalance_coordinator is not None
+                             and self.rebalance_coordinator.job is not None)
+                ):
+                    self.logger.info(
+                        "adopting committed topology from %s (epoch %d > "
+                        "local %d)", node.id, peer_epoch,
+                        self.cluster.routing_epoch)
+                    self._adopt_committed_topology(
+                        [Node.from_dict(n) for n in status["nodes"]],
+                        peer_epoch, anti_entropy=True)
                 # A probed peer reporting STARTING without us in its node
                 # list is a restarted coordinator waiting on topology
                 # quorum: re-send node-join so it can count us (the
@@ -1143,7 +1177,27 @@ class Server:
         if self._rebalance_dedupe("complete", msg):
             return
         nodes = [Node.from_dict(n) for n in msg.get("nodes", [])]
-        self.cluster.commit_topology(nodes, epoch=msg.get("epoch"))
+        self._adopt_committed_topology(nodes, msg.get("epoch"))
+
+    def _adopt_committed_topology(self, nodes, epoch,
+                                  anti_entropy: bool = False) -> None:
+        """Commit a finished rebalance's topology and run the follower-side
+        completion effects (grace/health cleanup, persisted topology,
+        epoch-guarded GC). Reached from the rebalance-complete broadcast
+        AND from the member monitor's epoch sync (anti_entropy=True), so a
+        follower that lost the broadcast still converges. The anti-entropy
+        path re-validates its decision atomically under the routing lock:
+        the monitor evaluated the adopt condition outside it, and a
+        rebalance-begin landing in between must not have its
+        next_nodes/migrated overrides wiped by this late commit."""
+        if anti_entropy:
+            if not self.cluster.adopt_topology_if_ahead(nodes, epoch):
+                self.logger.info(
+                    "topology adoption skipped: a rebalance began (or the "
+                    "epoch caught up) since the probe")
+                return
+        else:
+            self.cluster.commit_topology(nodes, epoch=epoch)
         self.cluster.health.clear_copy_grace()
         live = {n.id for n in self.cluster.nodes}
         self.cluster.health.prune_absent(live)
@@ -1160,6 +1214,17 @@ class Server:
             self.logger.info(
                 "rebalance complete: holder cleaner removed %d fragments",
                 len(removed))
+        # Thaw any fragment still frozen for a cutover of the job that
+        # just ended. After the cleaner, every remaining fragment belongs
+        # to a shard this node owns under the adopted topology — on the
+        # missed-ABORT recovery path (the job reverted, routing came back
+        # to us), and on a normal complete where this node was a
+        # migration source yet keeps the shard as a replica, a lingering
+        # _moved flag would leave it permanently write-dead.
+        thawed = self.migration_source.unfreeze(keep=())
+        if thawed:
+            self.logger.info(
+                "rebalance complete: thawed %d frozen fragments", thawed)
 
     def _handle_rebalance_abort(self, msg: dict) -> None:
         if self._rebalance_dedupe("abort", msg):

@@ -91,6 +91,10 @@ class TierManager:
             "delta_folds": 0, "shard_walks": 0, "corrupt_spills": 0,
             "disk_evictions": 0,
             "prefetch_promotions": 0, "prefetch_hits": 0,
+            # Swallowed-by-design failures (pilint R1): each has a correct
+            # fallback (retry later / treat shard as absent / skip the
+            # sweep), so the count is the only externally visible trace.
+            "demote_errors": 0, "capture_errors": 0, "prefetch_errors": 0,
         }
         # Engine-bound callables, wired by bind(): promote a key into HBM,
         # report free HBM bytes, and test HBM residency.
@@ -179,7 +183,10 @@ class TierManager:
             try:
                 self._demote_now(key)
             except Exception:
-                pass
+                # The plane stays cold (next read regathers from the
+                # fragments); the worker must survive to drain the queue.
+                with self._lock:
+                    self.counters["demote_errors"] += 1
             finally:
                 with self._demote_cv:
                     self._demote_busy -= 1
@@ -235,6 +242,10 @@ class TierManager:
             try:
                 data, fp = frag.row_compressed(leaf.row)
             except Exception:
+                # Fragment racing a delete/close reads as absent — the
+                # tier entry just omits this shard and promotion walks it.
+                with self._lock:
+                    self.counters["capture_errors"] += 1
                 fps.append(-1)
                 blobs.append(None)
                 continue
@@ -488,6 +499,10 @@ class TierManager:
                 try:
                     traffic = self._traffic_fn()
                 except Exception:
+                    # Traffic is advisory: the sweep falls back to the
+                    # untargeted MRU order.
+                    with self._lock:
+                        self.counters["prefetch_errors"] += 1
                     traffic = None
             with self._lock:
                 # MRU-first host keys, then disk: the most recently used
@@ -511,6 +526,8 @@ class TierManager:
                 try:
                     ok = self._promote_fn(key)
                 except Exception:
+                    with self._lock:
+                        self.counters["prefetch_errors"] += 1
                     ok = False
                 if ok:
                     with self._lock:

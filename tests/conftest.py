@@ -26,10 +26,48 @@ except AttributeError:
 
 
 import subprocess
+import sys
 import threading
 import time
 
 import pytest
+
+# Lock-order / blocking-under-lock instrumentation (devtools/lockcheck.py):
+# opt-in via PILOSA_TPU_LOCKCHECK=1, installed HERE — before any test
+# imports pilosa_tpu — so module-level locks (failpoints._mu, native._lock)
+# and every instance lock are constructed through the instrumented
+# factories. Loaded by FILE PATH, not `from pilosa_tpu.devtools import
+# lockcheck`: the package import would execute pilosa_tpu/__init__ first,
+# constructing those module-level locks as raw _thread locks before
+# install() patches the factories. lockcheck.py is stdlib-only so a path
+# load is safe; seeding sys.modules makes later package imports reuse this
+# instance (one global checker state). tests/test_lockcheck.py drives an
+# instrumented subprocess run of the chaos/tier/rebalance tests through
+# this hook and asserts the report (written at sessionfinish, path in
+# PILOSA_TPU_LOCKCHECK_OUT) comes back empty.
+_LOCKCHECK = os.environ.get("PILOSA_TPU_LOCKCHECK") == "1"
+if _LOCKCHECK:
+    import importlib.util
+
+    _lc_spec = importlib.util.spec_from_file_location(
+        "pilosa_tpu.devtools.lockcheck",
+        os.path.join(os.path.dirname(__file__), "..",
+                     "pilosa_tpu", "devtools", "lockcheck.py"))
+    _lockcheck = importlib.util.module_from_spec(_lc_spec)
+    sys.modules["pilosa_tpu.devtools.lockcheck"] = _lockcheck
+    _lc_spec.loader.exec_module(_lockcheck)
+    _lockcheck.install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _LOCKCHECK:
+        return
+    out = os.environ.get("PILOSA_TPU_LOCKCHECK_OUT")
+    if out:
+        _lockcheck.write_report(out)
+    fs = _lockcheck.findings()
+    if fs:
+        print("\n" + _lockcheck.report())
 
 
 def pytest_configure(config):

@@ -1,0 +1,599 @@
+"""pilint self-test: every rule proven on fixture snippets (violating and
+clean twins), the annotation grammar, then the real tree — tier-1 asserts
+`python -m tools.pilint pilosa_tpu/` stays at zero violations, which is
+what makes the PR-review invariants machine-enforced instead of
+re-derived by eye each round. See docs/static-analysis.md."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools.pilint.rules import RepoEnv, build_env  # noqa: E402
+from tools.pilint.runner import lint_source, lint_paths  # noqa: E402
+
+
+def lint(src: str, path: str = "pilosa_tpu/example.py", env: RepoEnv = None,
+         rules=None):
+    return lint_source(path, textwrap.dedent(src), env or RepoEnv(),
+                       rules=rules)
+
+
+def codes(violations):
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------------- R1
+
+
+class TestSwallowedExceptions:
+    def test_bare_pass_is_violation(self):
+        vs = lint("""
+            try:
+                work()
+            except Exception:
+                pass
+        """, rules=["R1"])
+        assert codes(vs) == ["R1"]
+
+    def test_bare_except_is_violation(self):
+        vs = lint("""
+            try:
+                work()
+            except:
+                pass
+        """, rules=["R1"])
+        assert codes(vs) == ["R1"]
+
+    def test_narrow_type_is_fine(self):
+        vs = lint("""
+            try:
+                work()
+            except KeyError:
+                pass
+        """, rules=["R1"])
+        assert vs == []
+
+    def test_reraise_is_fine(self):
+        vs = lint("""
+            try:
+                work()
+            except Exception:
+                cleanup()
+                raise
+        """, rules=["R1"])
+        assert vs == []
+
+    def test_log_is_fine(self):
+        vs = lint("""
+            try:
+                work()
+            except Exception as e:
+                logger.error("failed: %s", e)
+        """, rules=["R1"])
+        assert vs == []
+
+    def test_counter_increment_is_fine(self):
+        vs = lint("""
+            try:
+                work()
+            except Exception:
+                counters["errors"] += 1
+        """, rules=["R1"])
+        assert vs == []
+
+    def test_stats_count_is_fine(self):
+        vs = lint("""
+            try:
+                work()
+            except Exception:
+                stats.count("WorkError", 1)
+        """, rules=["R1"])
+        assert vs == []
+
+    def test_captured_error_is_fine(self):
+        # collect-and-raise-later (client.py parallel fan-out pattern)
+        vs = lint("""
+            try:
+                work()
+            except Exception as e:
+                first_error = first_error or e
+        """, rules=["R1"])
+        assert vs == []
+
+    def test_annotation_suppresses(self):
+        vs = lint("""
+            try:
+                work()
+            except Exception:  # pilint: allow-swallow(probe failure means fallback)
+                pass
+        """)
+        assert vs == []
+
+    def test_import_guard_must_catch_importerror(self):
+        vs = lint("""
+            try:
+                import fancy_dep
+            except Exception:
+                fancy_dep = None
+        """, rules=["R1"])
+        assert codes(vs) == ["R1"]
+        assert "ImportError" in vs[0].message
+
+    def test_import_guard_annotation_does_not_suppress(self):
+        vs = lint("""
+            try:
+                import fancy_dep
+            except Exception:  # pilint: allow-swallow(optional dependency)
+                fancy_dep = None
+        """, rules=["R1"])
+        assert codes(vs) == ["R1"]
+
+    def test_importerror_guard_is_fine(self):
+        vs = lint("""
+            try:
+                import fancy_dep
+            except ImportError:
+                fancy_dep = None
+        """, rules=["R1"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------- R2
+
+
+class TestJaxFreeZones:
+    def test_module_level_jax_in_zone(self):
+        vs = lint("import jax\n", path="pilosa_tpu/config.py", rules=["R2"])
+        assert codes(vs) == ["R2"]
+
+    def test_from_jax_in_zone(self):
+        vs = lint("from jax import numpy\n",
+                  path="pilosa_tpu/sched/batcher.py", rules=["R2"])
+        assert codes(vs) == ["R2"]
+
+    def test_jax_submodule_in_zone(self):
+        vs = lint("import jax.numpy as jnp\n",
+                  path="pilosa_tpu/tier/__init__.py", rules=["R2"])
+        assert codes(vs) == ["R2"]
+
+    def test_function_local_import_is_fine(self):
+        vs = lint("""
+            def gather():
+                import jax
+                return jax
+        """, path="pilosa_tpu/config.py", rules=["R2"])
+        assert vs == []
+
+    def test_type_checking_guard_is_fine(self):
+        vs = lint("""
+            from typing import TYPE_CHECKING
+            if TYPE_CHECKING:
+                import jax
+        """, path="pilosa_tpu/config.py", rules=["R2"])
+        assert vs == []
+
+    def test_type_checking_else_branch_still_checked(self):
+        # Only the if-body is typing-only; the else branch runs at import
+        # time and must still be a violation in a zone.
+        vs = lint("""
+            from typing import TYPE_CHECKING
+            if TYPE_CHECKING:
+                import jax
+            else:
+                import jax
+        """, path="pilosa_tpu/config.py", rules=["R2"])
+        assert codes(vs) == ["R2"]
+
+    def test_try_else_and_finally_still_checked(self):
+        # Every statement list of a try executes at import time — else
+        # and finally included, not just body and handlers.
+        vs = lint("""
+            try:
+                x = 1
+            except ImportError:
+                x = 2
+            else:
+                import jax
+            finally:
+                import jax.numpy
+        """, path="pilosa_tpu/config.py", rules=["R2"])
+        assert codes(vs) == ["R2", "R2"]
+
+    def test_loop_bodies_still_checked(self):
+        vs = lint("""
+            for _ in (1,):
+                import jax
+            while False:
+                import jax
+            else:
+                import jax.numpy
+        """, path="pilosa_tpu/config.py", rules=["R2"])
+        assert codes(vs) == ["R2", "R2", "R2"]
+
+    def test_outside_zone_is_fine(self):
+        vs = lint("import jax\n",
+                  path="pilosa_tpu/parallel/engine.py", rules=["R2"])
+        assert vs == []
+
+    def test_no_annotation_escape(self):
+        vs = lint(
+            "import jax  # pilint: allow-swallow(this kind does not apply)\n",
+            path="pilosa_tpu/config.py", rules=["R2"])
+        assert codes(vs) == ["R2"]
+
+
+# ---------------------------------------------------------------- R3
+
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock(self):
+        vs = lint("""
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+        """, rules=["R3"])
+        assert codes(vs) == ["R3"]
+
+    def test_fsync_under_mutex(self):
+        vs = lint("""
+            def f(self):
+                with self._mu:
+                    os.fsync(fd)
+        """, rules=["R3"])
+        assert codes(vs) == ["R3"]
+
+    def test_device_put_under_lock(self):
+        vs = lint("""
+            def f(self):
+                with self._lock:
+                    arr = jax.device_put(x)
+        """, rules=["R3"])
+        assert codes(vs) == ["R3"]
+
+    def test_sleep_outside_lock_is_fine(self):
+        vs = lint("""
+            def f(self):
+                with self._lock:
+                    x = 1
+                time.sleep(1)
+        """, rules=["R3"])
+        assert vs == []
+
+    def test_nested_function_not_flagged(self):
+        # the closure runs later, when the lock is not necessarily held
+        vs = lint("""
+            def f(self):
+                with self._lock:
+                    def worker():
+                        time.sleep(1)
+                    return worker
+        """, rules=["R3"])
+        assert vs == []
+
+    def test_non_lock_with_is_fine(self):
+        vs = lint("""
+            def f(self):
+                with open("x") as fh:
+                    time.sleep(1)
+        """, rules=["R3"])
+        assert vs == []
+
+    def test_annotation_suppresses(self):
+        vs = lint("""
+            def f(self):
+                with self._mu:
+                    # pilint: allow-blocking(close boundary, sync must land under the mutex)
+                    os.fsync(fd)
+        """, rules=["R3"])
+        assert vs == []
+
+    def test_condition_variable_counts_as_lock(self):
+        vs = lint("""
+            def f(self):
+                with self._demote_cv:
+                    time.sleep(1)
+        """, rules=["R3"])
+        assert codes(vs) == ["R3"]
+
+
+# ---------------------------------------------------------------- R4
+
+
+def _env_with_wiring(handler_src: str) -> RepoEnv:
+    return build_env({"pilosa_tpu/server/handler.py": textwrap.dedent(handler_src)})
+
+
+class TestCounterHygiene:
+    def test_unwired_counter_in_class_without_snapshot(self):
+        vs = lint("""
+            class Worker:
+                def run(self):
+                    self.counters["orphan_counter"] += 1
+        """, rules=["R4"])
+        assert codes(vs) == ["R4"]
+        assert "orphan_counter" in vs[0].message
+
+    def test_wholesale_snapshot_export_is_fine(self):
+        vs = lint("""
+            class Worker:
+                def run(self):
+                    self.counters["thing"] += 1
+                def snapshot(self):
+                    return dict(self.counters)
+        """, rules=["R4"])
+        assert vs == []
+
+    def test_partial_snapshot_is_not_wholesale(self):
+        # A snapshot() exporting a SUBSET must not grant the class R4
+        # immunity — the unexported counter is still unobservable.
+        vs = lint("""
+            class Worker:
+                def run(self):
+                    self.counters["orphan_counter"] += 1
+                def snapshot(self):
+                    return {"hits": self.counters["hits"]}
+        """, rules=["R4"])
+        assert codes(vs) == ["R4"]
+        assert "orphan_counter" in vs[0].message
+
+    def test_literal_in_wiring_corpus_is_fine(self):
+        env = _env_with_wiring("""
+            def handle_debug_vars(self):
+                return {"orphan_counter": x.orphan_counter}
+        """)
+        vs = lint("""
+            class Worker:
+                def run(self):
+                    self.counters["orphan_counter"] += 1
+        """, env=env, rules=["R4"])
+        assert vs == []
+
+    def test_stats_count_fine_while_wholesale_dump_exists(self):
+        env = _env_with_wiring("""
+            def handle_debug_vars(self):
+                out = stats.snapshot()
+                return out
+        """)
+        vs = lint("""
+            def f(stats):
+                stats.count("AnythingAtAll", 1)
+        """, env=env, rules=["R4"])
+        assert vs == []
+
+    def test_stats_count_flagged_without_wholesale_dump(self):
+        vs = lint("""
+            def f(stats):
+                stats.count("LostForever", 1)
+        """, rules=["R4"])
+        assert codes(vs) == ["R4"]
+
+    def test_annotation_suppresses(self):
+        vs = lint("""
+            class Worker:
+                def run(self):
+                    # pilint: allow-counter(test-only counter, asserted directly)
+                    self.counters["private"] += 1
+        """, rules=["R4"])
+        assert vs == []
+
+    def test_nested_class_judged_by_its_own_snapshot(self):
+        # A class defined inside a method must not inherit the OUTER
+        # class's wholesale-snapshot immunity.
+        vs = lint("""
+            class Outer:
+                def make(self):
+                    class Inner:
+                        def run(self):
+                            self.counters["inner_orphan"] += 1
+                    return Inner()
+                def snapshot(self):
+                    return dict(self.counters)
+        """, rules=["R4"])
+        assert codes(vs) == ["R4"]
+        assert "inner_orphan" in vs[0].message
+
+    def test_nested_class_with_own_snapshot_is_fine(self):
+        # ... and a nested class exporting its own counters wholesale is
+        # clean even when the enclosing class exports nothing.
+        vs = lint("""
+            class Outer:
+                def make(self):
+                    class Inner:
+                        def run(self):
+                            self.counters["inner_ok"] += 1
+                        def snapshot(self):
+                            return dict(self.counters)
+                    return Inner()
+        """, rules=["R4"])
+        assert vs == []
+
+    def test_outside_pilosa_tpu_not_checked(self):
+        vs = lint("""
+            class Worker:
+                def run(self):
+                    self.counters["whatever"] += 1
+        """, path="tools/example.py", rules=["R4"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------- R5
+
+
+class TestMutationEpochAudit:
+    def test_mutation_without_bump(self):
+        vs = lint("""
+            class Fragment:
+                def set_bit(self, pos):
+                    return self.storage.add(pos)
+        """, path="pilosa_tpu/core/fragment.py", rules=["R5"])
+        assert codes(vs) == ["R5"]
+        assert "set_bit" in vs[0].message
+
+    def test_direct_generation_bump_is_fine(self):
+        vs = lint("""
+            class Fragment:
+                def set_bit(self, pos):
+                    changed = self.storage.add(pos)
+                    self.generation += 1
+                    return changed
+        """, path="pilosa_tpu/core/fragment.py", rules=["R5"])
+        assert vs == []
+
+    def test_bump_via_helper_call_walk(self):
+        vs = lint("""
+            class Fragment:
+                def set_bit(self, pos):
+                    changed = self.storage.add(pos)
+                    self._invalidate(pos)
+                    return changed
+                def _invalidate(self, pos):
+                    self.generation += 1
+                    self.epoch.bump()
+        """, path="pilosa_tpu/core/fragment.py", rules=["R5"])
+        assert vs == []
+
+    def test_epoch_bump_call_is_fine(self):
+        vs = lint("""
+            class Fragment:
+                def read_from(self, f):
+                    self.storage.read_from(f)
+                    self.epoch.bump()
+        """, path="pilosa_tpu/core/fragment.py", rules=["R5"])
+        assert vs == []
+
+    def test_outside_core_not_checked(self):
+        vs = lint("""
+            class Thing:
+                def mutate(self):
+                    self.storage.add(1)
+        """, path="pilosa_tpu/tier/manager.py", rules=["R5"])
+        assert vs == []
+
+    def test_annotation_suppresses(self):
+        vs = lint("""
+            class Fragment:
+                # pilint: allow-mutation(recovery replay runs before any reader exists)
+                def _replay(self, data):
+                    self.storage.read_from(data)
+        """, path="pilosa_tpu/core/fragment.py", rules=["R5"])
+        assert vs == []
+
+
+# ------------------------------------------------------- annotation grammar
+
+
+class TestAnnotationGrammar:
+    def test_unknown_kind_is_violation(self):
+        vs = lint("x = 1  # pilint: allow-everything(just because)\n")
+        assert [v.rule for v in vs] == ["A0"]
+
+    def test_empty_reason_is_violation(self):
+        vs = lint("""
+            try:
+                work()
+            except Exception:  # pilint: allow-swallow()
+                pass
+        """, rules=None)
+        # the annotation still suppresses R1 (one finding per problem),
+        # but the missing reason is itself flagged
+        assert [v.rule for v in vs] == ["A0"]
+
+    def test_short_reason_is_violation(self):
+        vs = lint("""
+            try:
+                work()
+            except Exception:  # pilint: allow-swallow(ok)
+                pass
+        """)
+        assert [v.rule for v in vs] == ["A0"]
+
+    def test_unused_annotation_is_violation(self):
+        vs = lint("x = 1  # pilint: allow-swallow(nothing here swallows)\n")
+        assert [v.rule for v in vs] == ["A0"]
+        assert "unused" in vs[0].message
+
+    def test_unused_blocking_annotation_exempt(self):
+        # consumed by the runtime lock checker, which this pass can't see
+        vs = lint("x = 1  # pilint: allow-blocking(runtime-only lock context)\n")
+        assert vs == []
+
+    def test_annotation_on_line_above(self):
+        vs = lint("""
+            try:
+                work()
+            # pilint: allow-swallow(reason lives on the line above)
+            except Exception:
+                pass
+        """)
+        assert vs == []
+
+
+# ------------------------------------------------------------- real tree
+
+
+class TestRealTree:
+    def test_pilosa_tpu_is_clean(self):
+        """THE enforcement test: the shipped tree has zero unannotated
+        violations. A new swallowed except / jax import in a config
+        module / blocking call under a lock / orphaned counter fails
+        tier-1, not a human reviewer's attention."""
+        vs = lint_paths([os.path.join(REPO_ROOT, "pilosa_tpu")],
+                        repo_root=REPO_ROOT)
+        assert vs == [], "\n".join(str(v) for v in vs)
+
+    def test_cli_entry_exits_zero_on_clean_tree(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.pilint", "pilosa_tpu/"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 violations" in proc.stdout
+
+    def test_cli_entry_exits_nonzero_on_violation(self, tmp_path):
+        bad = tmp_path / "pilosa_tpu"
+        bad.mkdir()
+        (bad / "bad.py").write_text(
+            "try:\n    work()\nexcept Exception:\n    pass\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.pilint", str(bad)],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1
+        assert "R1" in proc.stdout
+
+    def test_cli_list_rules(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.pilint", "--list-rules"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0
+        for rule_id in ("R1", "R2", "R3", "R4", "R5"):
+            assert rule_id in proc.stdout
+
+    def test_every_annotation_carries_reason(self):
+        """Acceptance criterion: every allow-* annotation in the tree has
+        a human-readable reason (the A0 grammar checks run with the full
+        rule set in test_pilosa_tpu_is_clean; this asserts the grammar is
+        actually exercised — the tree DOES contain annotations)."""
+        from tools.pilint.core import parse_annotations
+
+        total = 0
+        for root, _dirs, files in os.walk(os.path.join(REPO_ROOT, "pilosa_tpu")):
+            for name in files:
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(root, name)
+                with open(full, "r", encoding="utf-8") as f:
+                    annotations, grammar_violations = parse_annotations(
+                        full, f.read())
+                assert grammar_violations == [], grammar_violations
+                total += len(annotations)
+                for a in annotations:
+                    assert len(a.reason) >= 4, (full, a)
+        assert total > 0, "expected the tree to carry pilint annotations"

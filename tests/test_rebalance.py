@@ -167,6 +167,16 @@ def test_join_live_writes_brownout(tmp_path):
                         read_stats["ok"] += 1
                     else:
                         read_stats["wrong"] += 1
+                        # Capture the cluster state the wrong count was
+                        # served under — it rides the assert message (this
+                        # is how the stale-epoch-stamp hole was diagnosed).
+                        read_stats.setdefault("wrong_detail", []).append({
+                            "got": got,
+                            "epochs": {s.node.id: s.cluster.routing_epoch
+                                       for s in list(servers)},
+                            "mid": {s.node.id: s.cluster.next_nodes is not None
+                                    for s in list(servers)},
+                        })
                 time.sleep(0.002)
 
         threads = [threading.Thread(target=writer, daemon=True),
@@ -201,7 +211,10 @@ def test_join_live_writes_brownout(tmp_path):
             except (ClientError, PilosaError):
                 return False
 
-        assert wait_for(converged, timeout=10)
+        # Generous margin: under lockcheck instrumentation each poll's 3
+        # monitor sweeps + probe round-trips slow by several x, and the
+        # reader/writer threads are still running.
+        assert wait_for(converged, timeout=20)
         time.sleep(0.1)  # a few post-rebalance reads/writes on clean links
         stop.set()
         for t in threads:
@@ -321,9 +334,14 @@ def test_coordinator_crash_resumes_from_checkpoint(tmp_path):
             json.dump({"jobID": "deadbeef", "newNodes": new_nodes,
                        "committed": []}, f)
         assert servers[0].maybe_resume_rebalance()
+        # Wait on jobs_completed, not just the topology commit: the
+        # counter bump happens-after _clear_state in _complete, so the
+        # checkpoint assertion below cannot race the cleanup.
         assert wait_for(
             lambda: len(servers[0].cluster.nodes) == 3
-            and servers[0].cluster.next_nodes is None, timeout=30,
+            and servers[0].cluster.next_nodes is None
+            and servers[0].rebalance_stats.counters.get(
+                "jobs_completed", 0) >= 1, timeout=30,
         ), "resumed rebalance did not complete"
         assert servers[0].rebalance_stats.counters["jobs_resumed"] == 1
         assert not os.path.exists(state_path)
@@ -369,9 +387,189 @@ def test_resume_skips_committed_shards(tmp_path):
         before = s0.rebalance_stats.counters["bytes_streamed"]
         assert s0.maybe_resume_rebalance()
         assert wait_for(lambda: s0.cluster.next_nodes is None
-                        and len(s0.cluster.nodes) == 2, timeout=15)
+                        and len(s0.cluster.nodes) == 2
+                        and s0.rebalance_stats.counters.get(
+                            "jobs_completed", 0) >= 1, timeout=15)
         assert s0.rebalance_stats.counters["bytes_streamed"] == before
         assert not os.path.exists(state_path)
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
+def test_fanout_stamps_epoch_of_placement_decision(tmp_path):
+    """The remote fan-out must stamp the routing epoch its PLACEMENT
+    decision was made under, not the epoch at send time: a cutover
+    landing between assign and dispatch advances the local epoch, and a
+    current-epoch stamp would slip the stale placement past the
+    receiver's 409 gate (it would serve a shard whose fragment it
+    already GC'd as silently empty)."""
+    ports = [free_port() for _ in range(2)]
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [
+        make_server(tmp_path, f"n{i}", ports[i], cluster_hosts=hosts)
+        for i in range(2)
+    ]
+    client = InternalClient()
+    try:
+        load_base(client, servers[0].node.uri)
+        ex = servers[0].executor
+        # A prior rebalance advanced both nodes to epoch 5.
+        servers[0].cluster.routing_epoch = 5
+        servers[1].cluster.routing_epoch = 5
+
+        stamped = []
+        real_client = ex.client
+
+        class RecordingClient:
+            def query_node(self, node, index, query, **kw):
+                stamped.append(kw.get("epoch"))
+                return real_client.query_node(node, index, query, **kw)
+
+            def __getattr__(self, name):
+                return getattr(real_client, name)
+
+        orig_assign = ex._assign_shards
+
+        def assign_then_cutover(*a, **kw):
+            out = orig_assign(*a, **kw)
+            # A cutover commits right after the placement read.
+            servers[0].cluster.routing_epoch += 1
+            return out
+
+        ex.client = RecordingClient()
+        ex._assign_shards = assign_then_cutover
+        try:
+            got = client.query(
+                servers[0].node.uri, "rb", "Count(Row(f=1))")["results"][0]
+        finally:
+            ex.client = real_client
+            ex._assign_shards = orig_assign
+        assert got == N_SHARDS
+        assert stamped and all(e == 5 for e in stamped), stamped
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
+def test_receiver_gate_treats_unstamped_as_epoch_zero(tmp_path):
+    """A remote query with NO X-Pilosa-Epoch stamp was routed by the
+    stalest possible placement (a sender that never saw the rebalance):
+    a receiver that has advanced past epoch 0 must 409 for a shard it
+    does not serve — never read a missing fragment as silently empty."""
+    ports = [free_port() for _ in range(2)]
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [
+        make_server(tmp_path, f"n{i}", ports[i], cluster_hosts=hosts)
+        for i in range(2)
+    ]
+    client = InternalClient()
+    try:
+        load_base(client, servers[0].node.uri)
+        s1 = servers[1]
+        not_served = next(
+            sh for sh in range(N_SHARDS)
+            if all(n.id != s1.node.id
+                   for n in s1.cluster.shard_nodes("rb", sh)))
+        # A rebalance advanced the receiver's epoch; the sender below
+        # never saw it and sends unstamped.
+        s1.cluster.routing_epoch = 3
+        with pytest.raises(ClientError) as ei:
+            client.query_node(
+                s1.cluster.node_by_id(s1.node.id), "rb",
+                "Count(Row(f=1))", shards=[not_served], remote=True)
+        assert getattr(ei.value, "status", 0) == 409, ei.value
+        # A shard the receiver DOES serve still answers unstamped
+        # requests (single-node tools, older senders).
+        served = next(
+            sh for sh in range(N_SHARDS)
+            if any(n.id == s1.node.id
+                   for n in s1.cluster.shard_nodes("rb", sh)))
+        res = client.query_node(
+            s1.cluster.node_by_id(s1.node.id), "rb",
+            "Count(Row(f=1))", shards=[served], remote=True)
+        assert res[0] == 1
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
+def test_monitor_adopts_missed_complete(tmp_path):
+    """A follower that LOST the rebalance-complete broadcast (a brown-out
+    can eat all transport retries) converges via the member monitor's
+    epoch sync: probing a peer whose /status reports a newer COMMITTED
+    routing epoch, it adopts that topology and GCs fragments for shards
+    it no longer owns."""
+    from pilosa_tpu.cluster.hash import partition as partition_of
+
+    def owner(hosts, shard):
+        ordered = sorted(hosts)
+        return ordered[partition_of("rb", shard, 256) % len(ordered)]
+
+    # A port triple where the 2->3 transition moves a shard OFF hosts[1]
+    # (the follower whose GC the lost broadcast would orphan).
+    for _ in range(256):
+        ports = [free_port() for _ in range(3)]
+        hosts = [f"localhost:{p}" for p in ports]
+        lost = [sh for sh in range(N_SHARDS)
+                if owner(hosts[:2], sh) == hosts[1]
+                and owner(hosts, sh) == hosts[2]]
+        if lost:
+            break
+    else:
+        raise RuntimeError("no port triple moves a shard off hosts[1]")
+
+    servers = [
+        make_server(tmp_path, f"n{i}", ports[i], cluster_hosts=hosts[:2])
+        for i in range(2)
+    ]
+    client = InternalClient()
+    try:
+        load_base(client, servers[0].node.uri)
+        s1 = servers[1]
+        assert s1.holder.fragment("rb", "f", "standard", lost[0]) is not None
+
+        # Simulate the peer having completed a rebalance whose complete
+        # broadcast never reached s1: n0 commits the 3-node topology
+        # (preserving its coordinator claim, as a real job's new_nodes
+        # do) and advances its epoch; s1 still routes on the 2-node view.
+        s0 = servers[0]
+        s0.cluster.commit_topology(
+            [Node(id=h, uri=h, is_coordinator=(h == s0.node.id))
+             for h in hosts],
+            epoch=s0.cluster.routing_epoch + 1)
+        assert len(s1.cluster.nodes) == 2
+        assert s1.cluster.routing_epoch < s0.cluster.routing_epoch
+
+        # Adoption is COORDINATOR-only: with n0's claim suppressed, a
+        # sweep must NOT adopt (a non-coordinator at a high epoch may
+        # just have seen a cutover-commit mid-job and still carry the
+        # old nodes list).
+        s0_entry = s0.cluster.node_by_id(s0.node.id)
+        s0_entry.is_coordinator = False
+        s1._monitor_members()
+        assert len(s1.cluster.nodes) == 2
+        s0_entry.is_coordinator = True
+
+        # One monitor sweep against the coordinator converges it.
+        s1._monitor_members()
+        assert s1.cluster.routing_epoch == s0.cluster.routing_epoch
+        assert len(s1.cluster.nodes) == 3
+        for sh in lost:
+            assert s1.holder.fragment("rb", "f", "standard", sh) is None, sh
+        kept = [sh for sh in range(N_SHARDS)
+                if owner(hosts, sh) == hosts[1]]
+        for sh in kept:
+            assert s1.holder.fragment("rb", "f", "standard", sh) is not None
     finally:
         for s in servers:
             try:
@@ -495,6 +693,35 @@ def test_routing_epoch_overrides_placement():
     c.commit_topology()
     assert c.next_nodes is None and c.migrated == set()
     assert len(c.nodes) == 3
+
+
+def test_adoption_loses_to_concurrent_begin():
+    """The anti-entropy topology adoption re-validates under the routing
+    lock: a rebalance-begin landing between the monitor's probe decision
+    and the commit keeps its next_nodes/migrated overrides — a late
+    adopt commit wiping them would route cut-over shards back to their
+    old owners until the job's complete broadcast."""
+    nodes = [Node(id="a", uri="a"), Node(id="b", uri="b")]
+    cluster = Cluster(node=nodes[0], nodes=nodes, hasher=ModHasher())
+    target = nodes + [Node(id="c", uri="c")]
+    # A begin wins the race: overrides installed, epoch merged to 7.
+    cluster.begin_rebalance(target, epoch=7)
+    cluster.apply_cutover("i", 3)
+    # The adoption loses even with a numerically newer epoch: overrides
+    # are in flight and must survive.
+    assert not cluster.adopt_topology_if_ahead(nodes, 9)
+    assert cluster.next_nodes is not None
+    assert ("i", 3) in cluster.migrated
+    # A caught-up epoch is also a losing race, overrides or not.
+    cluster.abort_rebalance()
+    assert not cluster.adopt_topology_if_ahead(target,
+                                               cluster.routing_epoch)
+    # Quiescent and genuinely ahead: the adoption commits.
+    epoch = cluster.routing_epoch
+    assert cluster.adopt_topology_if_ahead(target, epoch + 1)
+    assert cluster.routing_epoch == epoch + 1
+    assert [n.id for n in cluster.nodes] == ["a", "b", "c"]
+    assert cluster.next_nodes is None
 
 
 def test_abort_keeps_committed_cutovers():
@@ -626,6 +853,110 @@ def test_abort_unfreezes_uncommitted_shards(tmp_path):
         assert frag1._moved  # committed shard stays frozen
     finally:
         s.close()
+
+
+def test_complete_thaws_replica_kept_fragments(tmp_path):
+    """The coordinator's _complete must thaw fragments still frozen after
+    the holder cleaner runs: with replicas >= 2 the coordinator can be a
+    migration SOURCE for a shard it keeps owning as a replica — the
+    cleaner keeps that fragment, and a lingering _moved flag would leave
+    it permanently write-dead. (Followers already thaw the same way in
+    _adopt_committed_topology.)"""
+    from pilosa_tpu.cluster.rebalance import (RebalanceCoordinator,
+                                              RebalanceJob)
+
+    port = free_port()
+    s = make_server(tmp_path, "n0", port, cluster_hosts=[f"localhost:{port}"])
+    try:
+        client = InternalClient()
+        load_base(client, s.node.uri)
+        s.cluster.begin_rebalance(list(s.cluster.nodes))
+        s.migration_source.freeze("rb", 0)
+        frag = s.holder.fragment("rb", "f", "standard", 0)
+        assert frag._moved
+        coord = RebalanceCoordinator(s)
+        job = RebalanceJob("jt", list(s.cluster.nodes), moves={})
+        coord.job = job
+        # The single node keeps owning shard 0 under the new topology, so
+        # the cleaner keeps the fragment — exactly the replica-kept shape.
+        coord._complete(job)
+        assert not frag._moved
+        assert frag.set_bit(9, 1)
+    finally:
+        s.close()
+
+
+def test_forwarded_execution_rechecks_epoch_after_gather():
+    """A cutover committing DURING a forwarded (opt.remote) gather can GC
+    a moved shard's fragment mid-read so it reads as silently empty — the
+    entry gate in execute_query ran too early to see it. The receiver
+    re-checks the routing epoch after the gather and raises
+    StaleRoutingEpochError (-> 409, sender gets its free re-route)
+    instead of returning a result with a hole. An epoch bump that leaves
+    every shard still owned here stays transparent."""
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.errors import StaleRoutingEpochError
+    from pilosa_tpu.executor import ExecOptions, Executor
+
+    holder = Holder(None)
+    holder.open()
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.set_remote_max_shard(7)
+    nodes = [Node(id="a", uri="a"), Node(id="b", uri="b")]
+    cluster = Cluster(node=nodes[0], nodes=nodes, hasher=ModHasher())
+    ex = Executor(holder, cluster=cluster, workers=0)
+    cluster.begin_rebalance(nodes + [Node(id="c", uri="c")])
+    moved = None
+    for sh in range(8):
+        cluster.migrated.add(("i", sh))
+        owned = any(n.id == "a" for n in cluster.shard_nodes("i", sh))
+        cluster.migrated.discard(("i", sh))
+        if not owned:
+            moved = sh
+            break
+    assert moved is not None
+
+    pre_epoch = cluster.routing_epoch
+    opt = ExecOptions(remote=True, epoch=pre_epoch)
+
+    def gather_racing_cutover(shards_):
+        # The cutover moving this very shard off node 'a' commits while
+        # the gather is running (post-commit GC could have emptied it).
+        cluster.apply_cutover("i", moved)
+        return 0
+
+    with pytest.raises(StaleRoutingEpochError):
+        ex._fan_out("i", [moved], None, opt,
+                    gather_racing_cutover, lambda a, b: a + b)
+
+    # The cutover can also land BEFORE _fan_out but after execute()'s
+    # entry gate (during translation, or an earlier call of a multi-call
+    # query): the epoch anchor execute() captures before the gate still
+    # flags it, where a snapshot taken inside _fan_out would already be
+    # post-cutover and wave the hole through.
+    opt_anchored = ExecOptions(remote=True, epoch=pre_epoch,
+                               entry_epoch=pre_epoch)
+    with pytest.raises(StaleRoutingEpochError):
+        ex._fan_out("i", [moved], None, opt_anchored,
+                    lambda shards_: 0, lambda a, b: a + b)
+
+    # Epoch advanced mid-gather but the shard stayed local: the result is
+    # sound and must flow through, no spurious 409.
+    kept = next(
+        sh for sh in range(8)
+        if sh != moved and any(
+            n.id == "a" for n in cluster.shard_nodes("i", sh)))
+    opt2 = ExecOptions(remote=True, epoch=cluster.routing_epoch)
+
+    def gather_with_unrelated_bump(shards_):
+        cluster.routing_epoch += 1
+        return 42
+
+    assert ex._fan_out("i", [kept], None, opt2,
+                       gather_with_unrelated_bump, lambda a, b: a + b) == 42
+    ex.close()
+    holder.close()
 
 
 # ------------------------------------------------------------ health grace

@@ -1,0 +1,41 @@
+"""pilint: project-specific invariant lint for pilosa-tpu.
+
+Seven PRs of review notes distilled into machine-checkable rules
+(docs/static-analysis.md has the full contract):
+
+  R1 swallowed-exceptions   broad `except Exception` handlers must log,
+                            count, capture, or re-raise; broad guards
+                            around imports must catch ImportError.
+  R2 jax-free-zones         config-surface modules stay importable
+                            without jax (no module-level jax imports).
+  R3 blocking-under-lock    no deny-listed blocking call (sleep, fsync,
+                            socket/HTTP send, device_put, engine gather)
+                            lexically inside a `with <lock>:` block.
+  R4 counter-hygiene        every literal-keyed counter increment is
+                            reachable from /debug/vars (a wholesale
+                            `snapshot()` export or an explicit literal in
+                            handler.py/diagnostics.py).
+  R5 mutation-epoch-audit   core/ methods that mutate bitmap storage
+                            must reach a generation/epoch bump through
+                            the same-class call graph.
+
+Escape hatch: `# pilint: allow-<rule>(<reason>)` on the flagged line or
+the line above, with a mandatory human-readable reason. Unknown kinds,
+empty reasons, and annotations that suppress nothing are themselves
+violations, so the allow-list cannot rot silently.
+
+Run: `python -m tools.pilint pilosa_tpu/` (exit 1 on violations).
+Stdlib `ast` only — no third-party dependencies.
+"""
+
+from .core import Violation, Annotation, parse_annotations
+from .runner import lint_paths, lint_file, format_report
+
+__all__ = [
+    "Violation",
+    "Annotation",
+    "parse_annotations",
+    "lint_paths",
+    "lint_file",
+    "format_report",
+]

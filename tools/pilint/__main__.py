@@ -1,0 +1,51 @@
+"""CLI: `python -m tools.pilint pilosa_tpu/ [more paths] [--rule R1,R3]`.
+
+Exit status: 0 clean, 1 violations, 2 usage error. Run from the repo
+root (or pass --root) so zone/wiring paths resolve.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .runner import format_report, lint_paths
+from .rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.pilint",
+        description="pilosa-tpu invariant lint (see docs/static-analysis.md)",
+    )
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or directories to lint (default: pilosa_tpu/)")
+    parser.add_argument("--rule", help="comma-separated subset, e.g. R1,R3 "
+                        "(disables the unused-annotation check)")
+    parser.add_argument("--root", default=None,
+                        help="repo root for relative-path rules (default: cwd)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, fn in ALL_RULES:
+            print(f"{rule_id}  {fn.__name__.removeprefix('rule_')}")
+        return 0
+
+    paths = args.paths or ["pilosa_tpu"]
+    rules = None
+    if args.rule:
+        rules = [r.strip().upper() for r in args.rule.split(",") if r.strip()]
+        known = {rid for rid, _ in ALL_RULES}
+        bad = [r for r in rules if r not in known]
+        if bad:
+            print(f"unknown rule(s): {', '.join(bad)}", file=sys.stderr)
+            return 2
+
+    violations = lint_paths(paths, repo_root=args.root, rules=rules)
+    print(format_report(violations))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

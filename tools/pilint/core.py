@@ -1,0 +1,148 @@
+"""Shared lint plumbing: violations, annotation grammar, file context.
+
+The annotation grammar is deliberately rigid so it can be parsed with one
+regex and audited by grep:
+
+    # pilint: allow-<kind>(<reason>)
+
+`kind` is one of the KNOWN_KINDS below and `reason` is mandatory prose
+(>= 4 characters — "wip" does not explain anything to the next reader).
+An annotation applies to the line it sits on and to the line directly
+below it (so it can ride above a statement too long to share a line).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# One kind per rule that supports suppression. R2 (jax-free zones) has no
+# escape hatch on purpose: a jax import in a config module is never
+# acceptable — move the import into the function that needs it.
+KNOWN_KINDS = ("swallow", "blocking", "counter", "mutation")
+
+_ANNOT_RE = re.compile(
+    r"#\s*pilint:\s*allow-(?P<kind>[a-z][a-z-]*)\((?P<reason>[^)]*)\)"
+)
+
+MIN_REASON = 4
+
+
+@dataclass
+class Violation:
+    path: str
+    line: int
+    rule: str  # "R1".."R5" or "A0" for annotation-grammar violations
+    name: str  # short rule slug
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.name}: {self.message}"
+
+    def sort_key(self) -> Tuple[str, int, str]:
+        return (self.path, self.line, self.rule)
+
+
+@dataclass
+class Annotation:
+    line: int
+    kind: str
+    reason: str
+    used: bool = False
+
+
+@dataclass
+class FileContext:
+    """Everything a rule needs about one file, parsed once."""
+
+    path: str  # repo-relative, forward slashes
+    source: str
+    tree: ast.AST
+    annotations: List[Annotation] = field(default_factory=list)
+    # line -> annotations covering that line (own line + line below)
+    _by_line: Dict[int, List[Annotation]] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        for a in self.annotations:
+            self._by_line.setdefault(a.line, []).append(a)
+            self._by_line.setdefault(a.line + 1, []).append(a)
+
+    def allowed(self, line: int, kind: str) -> bool:
+        """True (and marks the annotation used) when `line` carries or sits
+        directly under an `allow-<kind>` annotation."""
+        for a in self._by_line.get(line, ()):
+            if a.kind == kind:
+                a.used = True
+                return True
+        return False
+
+
+def parse_annotations(path: str, source: str) -> Tuple[List[Annotation], List[Violation]]:
+    """Extract annotations and grammar violations from raw source.
+
+    Grammar violations (A0): unknown kind, missing/too-short reason. A
+    malformed annotation is still RECORDED so the rule it meant to
+    suppress stays suppressed — one finding per problem, not two."""
+    annotations: List[Annotation] = []
+    violations: List[Violation] = []
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        for m in _ANNOT_RE.finditer(text):
+            kind, reason = m.group("kind"), m.group("reason").strip()
+            annotations.append(Annotation(line=lineno, kind=kind, reason=reason))
+            if kind not in KNOWN_KINDS:
+                violations.append(Violation(
+                    path, lineno, "A0", "annotation-grammar",
+                    f"unknown annotation kind 'allow-{kind}' "
+                    f"(known: {', '.join('allow-' + k for k in KNOWN_KINDS)})",
+                ))
+            elif len(reason) < MIN_REASON:
+                violations.append(Violation(
+                    path, lineno, "A0", "annotation-grammar",
+                    f"allow-{kind} needs a human-readable reason "
+                    f"(got {reason!r})",
+                ))
+    return annotations, violations
+
+
+def unused_annotation_violations(ctx: FileContext) -> List[Violation]:
+    """Annotations that suppressed nothing are stale and must go — a rot
+    check run AFTER all rules so `used` flags are final.
+
+    `allow-blocking` is exempt: the runtime lock checker
+    (pilosa_tpu/devtools/lockcheck.py) consumes the same grammar for
+    calls that only BECOME lock-held dynamically (an fsync inside a
+    helper its caller locks around), which this static pass can't see."""
+    out = []
+    for a in ctx.annotations:
+        if a.kind == "blocking":
+            continue
+        if a.kind in KNOWN_KINDS and len(a.reason) >= MIN_REASON and not a.used:
+            out.append(Violation(
+                ctx.path, a.line, "A0", "annotation-grammar",
+                f"unused allow-{a.kind} annotation (nothing on this line "
+                "or the line below triggers that rule) — delete it",
+            ))
+    return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The last component of a Name/Attribute chain ('c' for a.b.c)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None

@@ -1,0 +1,483 @@
+"""The five pilint rules. Each rule is a function(ctx, env) -> [Violation].
+
+`env` is a RepoEnv carrying the cross-file facts some rules need (R4's
+/debug/vars wiring corpus). Rules are pure AST walks — no imports of the
+linted code, so a file with a missing optional dependency still lints.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .core import FileContext, Violation, dotted_name, terminal_name
+
+# --------------------------------------------------------------------------
+# cross-file environment
+
+
+@dataclass
+class RepoEnv:
+    """Facts gathered once per run, consumed by individual rules.
+
+    wired_literals: every string literal in the /debug/vars wiring files
+        (server/handler.py, diagnostics.py) — a counter key appearing
+        there is observable by an operator.
+    stats_wholesale: True when handler.py dumps `stats.snapshot()`
+        wholesale into /debug/vars, which makes every `stats.count(name)`
+        counter observable without listing its name.
+    """
+
+    wired_literals: Set[str] = field(default_factory=set)
+    stats_wholesale: bool = False
+
+
+WIRING_FILES = ("pilosa_tpu/server/handler.py", "pilosa_tpu/diagnostics.py")
+
+
+def build_env(sources: Dict[str, str]) -> RepoEnv:
+    env = RepoEnv()
+    for rel in WIRING_FILES:
+        src = sources.get(rel)
+        if src is None:
+            continue
+        tree = ast.parse(src)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                env.wired_literals.add(node.value)
+            if (isinstance(node, ast.Call)
+                    and terminal_name(node.func) == "snapshot"
+                    and isinstance(node.func, ast.Attribute)
+                    and terminal_name(node.func.value) == "stats"):
+                env.stats_wholesale = True
+    return env
+
+
+# --------------------------------------------------------------------------
+# R1: no swallowed exceptions
+
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:  # bare except:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(terminal_name(e) in _BROAD for e in t.elts)
+    return terminal_name(t) in _BROAD
+
+
+def _body_handles(h: ast.ExceptHandler) -> bool:
+    """True when the handler body re-raises, logs, counts, or captures the
+    exception for later use — i.e. the failure leaves a trace."""
+    exc_name = h.name
+    for node in ast.walk(ast.Module(body=list(h.body), type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                base = terminal_name(fn.value) or ""
+                # self.logger.error(...), logging.warning(...), log.info(...)
+                if fn.attr in _LOG_METHODS and "log" in base.lower():
+                    return True
+                # stats.count("X", n) / self._stats.add_pending(...)
+                if fn.attr == "count":
+                    return True
+        # counters["x"] += 1 / self.quarantined_reads += 1
+        if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+            tgt = node.target
+            if isinstance(tgt, ast.Subscript) or isinstance(tgt, ast.Attribute):
+                return True
+        # `except ... as e` whose body USES e (stores it, appends it,
+        # formats it into a result): the error is captured, not dropped.
+        if (exc_name and isinstance(node, ast.Name)
+                and node.id == exc_name and isinstance(node.ctx, ast.Load)):
+            return True
+    return False
+
+
+def _try_body_imports(handler: ast.ExceptHandler, tree: ast.AST) -> bool:
+    """True when `handler` belongs to a Try whose body is import work."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Try) and handler in node.handlers:
+            return any(isinstance(s, (ast.Import, ast.ImportFrom))
+                       for s in node.body)
+    return False
+
+
+def rule_swallow(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler) or not _is_broad(node):
+            continue
+        if _try_body_imports(node, ctx.tree):
+            # No annotation escape: a broad guard around an import hides
+            # typos inside the guarded module forever. Catch ImportError.
+            out.append(Violation(
+                ctx.path, node.lineno, "R1", "swallowed-exceptions",
+                "broad except around an import guard — catch ImportError "
+                "(a typo inside the imported module currently vanishes)",
+            ))
+            continue
+        if _body_handles(node):
+            continue
+        if ctx.allowed(node.lineno, "swallow"):
+            continue
+        out.append(Violation(
+            ctx.path, node.lineno, "R1", "swallowed-exceptions",
+            "broad except swallows the error: log it, count it into "
+            "/debug/vars, re-raise, narrow the type, or annotate "
+            "`# pilint: allow-swallow(reason)`",
+        ))
+    return out
+
+
+# --------------------------------------------------------------------------
+# R2: jax-free zones
+
+
+# Modules the configuration surface imports at CLI startup; they must
+# stay importable on a box with no jax (docs/static-analysis.md).
+JAX_FREE_ZONES = (
+    "pilosa_tpu/config.py",
+    "pilosa_tpu/ingest.py",
+    "pilosa_tpu/tier/__init__.py",
+    "pilosa_tpu/parallel/__init__.py",
+    "pilosa_tpu/sched/",
+)
+
+
+def _in_zone(path: str) -> bool:
+    return any(path == z or (z.endswith("/") and path.startswith(z))
+               for z in JAX_FREE_ZONES)
+
+
+def rule_jax_free(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    if not _in_zone(ctx.path):
+        return []
+    out: List[Violation] = []
+
+    def check(body, toplevel: bool) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # deferred to call time: allowed
+            if isinstance(node, ast.If):
+                test = node.test
+                if terminal_name(test) == "TYPE_CHECKING":
+                    # The if-body is typing-only and never executes, but an
+                    # `else:` branch DOES run at import time — keep checking it.
+                    check(node.orelse, toplevel)
+                    continue
+                check(node.body, toplevel)
+                check(node.orelse, toplevel)
+                continue
+            if isinstance(node, (ast.Try, ast.With, ast.AsyncWith,
+                                 ast.ClassDef, ast.For, ast.AsyncFor,
+                                 ast.While)):
+                # Every statement list of a compound statement executes at
+                # import time (only def bodies defer): try/else/finally,
+                # loop bodies and their else clauses included.
+                check(node.body, toplevel)
+                if isinstance(node, ast.Try):
+                    for h in node.handlers:
+                        check(h.body, toplevel)
+                    check(node.orelse, toplevel)
+                    check(node.finalbody, toplevel)
+                if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                    check(node.orelse, toplevel)
+                continue
+            names = []
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                names = [node.module]
+            for n in names:
+                if n == "jax" or n.startswith("jax."):
+                    out.append(Violation(
+                        ctx.path, node.lineno, "R2", "jax-free-zones",
+                        f"module-level `import {n}` in a jax-free zone — "
+                        "move it inside the function that needs it",
+                    ))
+
+    check(ctx.tree.body, True)
+    return out
+
+
+# --------------------------------------------------------------------------
+# R3: no blocking calls under a lock
+
+
+_LOCK_NAME_RE = re.compile(
+    r"(?:^|_)(lock|rlock|mu|mutex|cv|cond)\d*$", re.IGNORECASE
+)
+
+# Deny-listed *direct* calls inside a `with <lock>:` block. This is a
+# lexical check — calls that block transitively are the runtime lock
+# checker's job (pilosa_tpu/devtools/lockcheck.py). Each entry is either
+# a full dotted name or ('*', terminal_attr).
+_DENY_DOTTED = {
+    "time.sleep", "_time.sleep",
+    "os.fsync", "os.fdatasync", "os.replace", "os.rename",
+    "shutil.move", "shutil.copyfile",
+    "jax.device_put",
+    "socket.create_connection",
+    "urllib.request.urlopen",
+}
+_DENY_TERMINAL = {
+    # socket / HTTP client sends
+    "urlopen", "getresponse", "sendall", "create_connection",
+    "send_message",
+    # device transfers + engine gathers (serialize off-lock: PR 5/7 rules)
+    "device_put", "block_until_ready", "_gather_leaf",
+    "_stacked_leaf_tensor",
+    # durability syscalls regardless of the module alias
+    "fsync", "fdatasync",
+}
+
+
+def _is_lock_name(expr: ast.AST) -> bool:
+    name = terminal_name(expr)
+    return bool(name and _LOCK_NAME_RE.search(name))
+
+
+def _deny_match(call: ast.Call) -> Optional[str]:
+    dn = dotted_name(call.func)
+    if dn in _DENY_DOTTED:
+        return dn
+    term = terminal_name(call.func)
+    if term in _DENY_TERMINAL:
+        return dn or term
+    return None
+
+
+def rule_blocking_under_lock(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    out: List[Violation] = []
+
+    def _scan_node(node: ast.AST) -> None:
+        """Walk a statement inside a held-lock region, pruning nested
+        function/lambda bodies (they run later, lock not necessarily
+        held)."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            hit = _deny_match(node)
+            if hit and not ctx.allowed(node.lineno, "blocking"):
+                out.append(Violation(
+                    ctx.path, node.lineno, "R3", "blocking-under-lock",
+                    f"blocking call `{hit}` inside a `with <lock>:` block — "
+                    "serialize off-lock (docs/durability.md, "
+                    "docs/tiered-storage.md) or annotate "
+                    "`# pilint: allow-blocking(reason)`",
+                ))
+        for child in ast.iter_child_nodes(node):
+            _scan_node(child)
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.With) and any(
+                _is_lock_name(item.context_expr) for item in node.items):
+            for stmt in node.body:
+                _scan_node(stmt)
+            # nested withs inside are re-visited below, which is fine:
+            # the outer scan already reported their bodies' direct calls,
+            # and allowed() marks by line so duplicates collapse.
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(ctx.tree)
+    # de-duplicate (nested lock-withs make the outer and inner visit both
+    # report the same call)
+    seen: Set[tuple] = set()
+    unique = []
+    for v in out:
+        k = (v.line, v.message)
+        if k not in seen:
+            seen.add(k)
+            unique.append(v)
+    return unique
+
+
+# --------------------------------------------------------------------------
+# R4: counter hygiene
+
+
+def _is_self_counters(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "counters"
+            and terminal_name(node.value) == "self")
+
+
+def _class_has_wholesale_snapshot(cls: ast.ClassDef) -> bool:
+    # A snapshot() only counts as wholesale when it exports the WHOLE
+    # counter dict — `dict(self.counters)`, `self.counters.copy()`,
+    # `{**self.counters, ...}`, or `return self.counters` — not merely any
+    # mention of self.counters. A partial export (`self.counters['hits']`)
+    # must NOT grant the whole class R4 immunity.
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "snapshot":
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id == "dict"
+                        and any(_is_self_counters(a) for a in sub.args)):
+                    return True
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "copy"
+                        and _is_self_counters(sub.func.value)):
+                    return True
+                if isinstance(sub, ast.Dict) and any(
+                        k is None and _is_self_counters(v)
+                        for k, v in zip(sub.keys, sub.values)):
+                    return True
+                if isinstance(sub, ast.Return) and _is_self_counters(sub.value):
+                    return True
+    return False
+
+
+def rule_counter_hygiene(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    if not ctx.path.startswith("pilosa_tpu/"):
+        return []
+    out: List[Violation] = []
+
+    def scan(body, wholesale: bool) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                scan(node.body, _class_has_wholesale_snapshot(node))
+                continue
+            # BFS that PRUNES nested ClassDefs (classes inside functions):
+            # each is re-dispatched through scan() so its increments are
+            # judged against its OWN snapshot(), not the enclosing class's.
+            todo = [node]
+            while todo:
+                sub = todo.pop(0)
+                if isinstance(sub, ast.ClassDef):
+                    scan(sub.body, _class_has_wholesale_snapshot(sub))
+                    continue
+                todo.extend(ast.iter_child_nodes(sub))
+                # counters["key"] += n
+                if (isinstance(sub, ast.AugAssign)
+                        and isinstance(sub.target, ast.Subscript)
+                        and terminal_name(sub.target.value) == "counters"):
+                    sl = sub.target.slice
+                    if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                        key = sl.value
+                        if (not wholesale
+                                and key not in env.wired_literals
+                                and not ctx.allowed(sub.lineno, "counter")):
+                            out.append(Violation(
+                                ctx.path, sub.lineno, "R4", "counter-hygiene",
+                                f"counter {key!r} is incremented but not "
+                                "reachable from /debug/vars: export it via a "
+                                "wholesale snapshot() or wire the literal in "
+                                "handler.py/diagnostics.py",
+                            ))
+                # stats.count("Name", n)
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "count" and sub.args):
+                    a0 = sub.args[0]
+                    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                        name = a0.value
+                        if (not env.stats_wholesale
+                                and name not in env.wired_literals
+                                and not ctx.allowed(sub.lineno, "counter")):
+                            out.append(Violation(
+                                ctx.path, sub.lineno, "R4", "counter-hygiene",
+                                f"stats counter {name!r} is not surfaced: "
+                                "/debug/vars no longer dumps stats.snapshot() "
+                                "wholesale and the name appears nowhere in "
+                                "the wiring files",
+                            ))
+
+    scan(ctx.tree.body, False)
+    return out
+
+
+# --------------------------------------------------------------------------
+# R5: mutation-epoch audit (core/ only)
+
+
+_STORAGE_MUTATORS = {"add", "remove", "add_many", "remove_many",
+                     "add_sorted", "remove_sorted", "read_from"}
+_BUMP_CALLS = {"bump", "_invalidate_row", "_invalidate_bulk", "_journal_reset"}
+
+
+def _method_facts(fn: ast.FunctionDef):
+    """(mutates: [lineno], bumps: bool, callees: set[str]) for one method."""
+    mutates: List[int] = []
+    bumps = False
+    callees: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                base = terminal_name(f.value)
+                if f.attr in _STORAGE_MUTATORS and base == "storage":
+                    mutates.append(node.lineno)
+                if f.attr in _BUMP_CALLS:
+                    bumps = True
+                if isinstance(f.value, ast.Name) and f.value.id == "self":
+                    callees.add(f.attr)
+            elif isinstance(f, ast.Name):
+                if f.id == "replay_ops":
+                    mutates.append(node.lineno)
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == "generation":
+                    bumps = True
+    return mutates, bumps, callees
+
+
+def rule_mutation_epoch(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    if "core/" not in ctx.path:
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods = {m.name: m for m in node.body
+                   if isinstance(m, ast.FunctionDef)}
+        facts = {name: _method_facts(fn) for name, fn in methods.items()}
+
+        def reaches_bump(name: str, seen: Set[str]) -> bool:
+            if name in seen or name not in facts:
+                return False
+            seen.add(name)
+            _, bumps, callees = facts[name]
+            if bumps:
+                return True
+            return any(reaches_bump(c, seen) for c in callees)
+
+        for name, fn in methods.items():
+            mutates, _, _ = facts[name]
+            if not mutates:
+                continue
+            if reaches_bump(name, set()):
+                continue
+            if ctx.allowed(fn.lineno, "mutation"):
+                continue
+            out.append(Violation(
+                ctx.path, fn.lineno, "R5", "mutation-epoch-audit",
+                f"`{name}` mutates bitmap storage (line {mutates[0]}) but "
+                "never reaches a generation/epoch bump — stale device "
+                "caches would serve the old plane; bump or annotate "
+                "`# pilint: allow-mutation(reason)`",
+            ))
+    return out
+
+
+ALL_RULES = (
+    ("R1", rule_swallow),
+    ("R2", rule_jax_free),
+    ("R3", rule_blocking_under_lock),
+    ("R4", rule_counter_hygiene),
+    ("R5", rule_mutation_epoch),
+)

@@ -1,0 +1,93 @@
+"""File discovery + rule orchestration + report formatting."""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .core import (FileContext, Violation, parse_annotations,
+                   unused_annotation_violations)
+from .rules import ALL_RULES, RepoEnv, WIRING_FILES, build_env
+
+_SKIP_PARTS = {"__pycache__", ".git"}
+
+
+def _discover(paths: Iterable[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            files.append(p)
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_PARTS)
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    files.append(os.path.join(root, n))
+    return files
+
+
+def _relpath(path: str, repo_root: Optional[str]) -> str:
+    root = repo_root or os.getcwd()
+    try:
+        rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    except ValueError:  # different drive (windows): keep as-is
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def lint_file(path: str, env: RepoEnv, repo_root: Optional[str] = None,
+              rules: Optional[Iterable[str]] = None) -> List[Violation]:
+    rel = _relpath(path, repo_root)
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(rel, source, env, rules=rules)
+
+
+def lint_source(rel_path: str, source: str, env: RepoEnv,
+                rules: Optional[Iterable[str]] = None) -> List[Violation]:
+    """Lint one in-memory module (the fixture-snippet path for tests)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation(rel_path, e.lineno or 0, "E0", "syntax-error",
+                          str(e.msg))]
+    annotations, violations = parse_annotations(rel_path, source)
+    ctx = FileContext(path=rel_path, source=source, tree=tree,
+                      annotations=annotations)
+    selected = set(rules) if rules else None
+    for rule_id, rule_fn in ALL_RULES:
+        if selected and rule_id not in selected:
+            continue
+        violations.extend(rule_fn(ctx, env))
+    # Only meaningful when every rule ran — a partial run would call
+    # legitimately-needed annotations unused.
+    if selected is None:
+        violations.extend(unused_annotation_violations(ctx))
+    return sorted(violations, key=Violation.sort_key)
+
+
+def lint_paths(paths: Iterable[str], repo_root: Optional[str] = None,
+               rules: Optional[Iterable[str]] = None) -> List[Violation]:
+    """Lint every .py file under `paths`. repo_root anchors the relative
+    paths rules match on (zone membership, wiring files); default cwd."""
+    files = _discover(paths)
+    root = repo_root or os.getcwd()
+    sources: Dict[str, str] = {}
+    for rel in WIRING_FILES:
+        full = os.path.join(root, rel)
+        if os.path.exists(full):
+            with open(full, "r", encoding="utf-8") as f:
+                sources[rel] = f.read()
+    env = build_env(sources)
+    out: List[Violation] = []
+    for f in files:
+        out.extend(lint_file(f, env, repo_root=root, rules=rules))
+    return sorted(out, key=Violation.sort_key)
+
+
+def format_report(violations: List[Violation]) -> str:
+    lines = [str(v) for v in violations]
+    n = len(violations)
+    lines.append(f"pilint: {n} violation{'s' if n != 1 else ''}")
+    return "\n".join(lines)
