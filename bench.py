@@ -1126,6 +1126,191 @@ def bench_fault():
     return out
 
 
+# --------------------------------------- device-plane degradation stanza
+
+
+def bench_degrade():
+    """Device-fault degraded ladder (docs/fault-tolerance.md, device
+    section): one node serves Count queries while the device plane is
+    scripted through healthy -> device-fault (every engine dispatch
+    raises; the plane breaker opens and queries answer from the
+    host/compressed-domain ladder) -> healed (half-open probe re-closes
+    the breaker). Reports per-phase qps/p50/p99, correctness of the
+    degraded phase (bit-exact vs healthy — the acceptance bar: a device
+    fault is a performance event, not an availability event), an
+    injected-OOM probe (backpressure + retry, no client error), and the
+    recovery time from fault-clear to a re-closed breaker with queries
+    proven back on the device path by the dispatch counter."""
+    import shutil
+    import socket
+    import tempfile
+
+    from pilosa_tpu import failpoints
+    from pilosa_tpu.cluster.health import ResilienceConfig
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.errors import PilosaError
+    from pilosa_tpu.server.client import ClientError, InternalClient
+    from pilosa_tpu.server.server import Server
+
+    n_rows, per_phase = (3, 8) if SMOKE else (6, 60)
+    n_shards = 2 if SMOKE else 4
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmp = tempfile.mkdtemp(prefix="bench-degrade-")
+    port = free_port()
+    host = f"localhost:{port}"
+    out = {"shards": n_shards, "rows": n_rows, "queries_per_phase": per_phase}
+    # Memos off for the whole stanza: a memo hit dispatches nothing, so
+    # the fault phase would never exercise the ladder (the engine reads
+    # this env at lazy construction).
+    old_memo = os.environ.get("PILOSA_MEMO_ENTRIES")
+    os.environ["PILOSA_MEMO_ENTRIES"] = "0"
+    server = None
+    try:
+        server = Server(
+            data_dir=os.path.join(tmp, "node0"),
+            port=port,
+            cluster_hosts=[host],
+            cache_flush_interval=0,
+            anti_entropy_interval=0,
+            member_monitor_interval=0,
+            resilience_config=ResilienceConfig(
+                device_breaker_failures=2, device_breaker_backoff=0.05,
+                device_breaker_backoff_max=0.5, device_sig_backoff=0.05),
+        )
+        server.open()
+        client = InternalClient(timeout=10.0)
+        client.create_index(host, "dg")
+        client.create_field(host, "dg", "f")
+        for row in range(n_rows):
+            for shard in range(n_shards):
+                for k in range(4 + row):
+                    client.query(
+                        host, "dg",
+                        f"Set({shard * SHARD_WIDTH + row * 31 + k * 7}, "
+                        f"f={row})")
+
+        def run_phase(n):
+            lat, values = [], []
+            ok = err = 0
+            t0 = time.perf_counter()
+            for i in range(n):
+                q0 = time.perf_counter()
+                try:
+                    r = client.query(
+                        host, "dg", f"Count(Row(f={i % n_rows}))")
+                    values.append((i % n_rows, r["results"][0]))
+                    ok += 1
+                    lat.append(time.perf_counter() - q0)
+                except (ClientError, PilosaError):
+                    err += 1
+            dt = time.perf_counter() - t0
+            lat.sort()
+            pick = (lambda q: round(
+                lat[min(len(lat) - 1, int(len(lat) * q))] * 1e3, 2
+            )) if lat else (lambda q: None)
+            return {"qps": round(ok / dt, 1) if dt else 0.0,
+                    "p50_ms": pick(0.50), "p99_ms": pick(0.99),
+                    "ok": ok, "errors": err}, dict(values)
+
+        out["healthy"], baseline = run_phase(per_phase)
+
+        # Device-fault phase: EVERY dispatch raises; after
+        # device-breaker-failures the plane breaker opens and queries are
+        # host-routed without touching the device at all.
+        failpoints.configure("device-dispatch", "error")
+        out["device_fault"], degraded = run_phase(per_phase)
+        out["correct"] = bool(baseline) and degraded == baseline
+        engine = server.executor._engine
+        dp = engine.device_health.snapshot()
+        out["fault_detail"] = {
+            "plane_state": dp["plane_state"],
+            "plane_opened": dp["plane_opened"],
+            "host_counts": engine.counters["host_counts"],
+            "dispatch_failures": dp["dispatch_failures"],
+        }
+
+        # OOM probe: one injected RESOURCE_EXHAUSTED must be absorbed by
+        # backpressure (budget shrink + demote + retry), never a client
+        # error. Run it healed so the dispatch actually happens.
+        failpoints.reset()
+        deadline = time.perf_counter() + 20.0
+        while (time.perf_counter() < deadline
+               and engine.device_health.plane_state() != "closed"):
+            try:
+                client.query(host, "dg", "Count(Row(f=0))")
+            except (ClientError, PilosaError):
+                pass
+            time.sleep(0.02)
+        failpoints.configure("device-dispatch", "oom", count=1)
+        oom_phase, _ = run_phase(max(2, n_rows))
+        out["oom"] = {
+            "errors": oom_phase["errors"],
+            "backpressure": engine.counters["oom_backpressure"],
+            "retries": engine.counters["oom_retries"],
+        }
+        failpoints.reset()
+
+        # Recovery: breaker re-closed AND dispatch counter climbing again
+        # (the proof queries are back on the device, not the ladder).
+        failpoints.configure("device-dispatch", "error", count=3)
+        for i in range(4):
+            try:
+                client.query(host, "dg", f"Count(Row(f={i % n_rows}))")
+            except (ClientError, PilosaError):
+                pass
+        failpoints.reset()
+        t0 = time.perf_counter()
+        recovered = False
+        # Generous bound: smoke runs on loaded CI boxes, and the breaker
+        # convergence itself is ~50ms — the window absorbs scheduler
+        # stalls, not protocol time.
+        deadline = t0 + 30.0
+        while time.perf_counter() < deadline and not recovered:
+            base_dispatch = engine.counters["count_dispatches"]
+            try:
+                for row in range(n_rows):
+                    client.query(host, "dg", f"Count(Row(f={row}))")
+            except (ClientError, PilosaError):
+                time.sleep(0.02)
+                continue
+            recovered = (
+                engine.device_health.plane_state() == "closed"
+                and engine.counters["count_dispatches"] > base_dispatch
+            )
+            if not recovered:
+                time.sleep(0.02)
+        out["recovery_s"] = round(time.perf_counter() - t0, 3)
+        out["recovered"] = recovered
+        out["healed"], healed_vals = run_phase(per_phase)
+        out["healed_correct"] = healed_vals == baseline
+        out["degrade_ok"] = bool(
+            out["correct"]
+            and out["device_fault"]["errors"] == 0
+            and out["oom"]["errors"] == 0
+            and recovered
+        )
+    finally:
+        failpoints.reset()
+        if old_memo is None:
+            os.environ.pop("PILOSA_MEMO_ENTRIES", None)
+        else:
+            os.environ["PILOSA_MEMO_ENTRIES"] = old_memo
+        if server is not None:
+            try:
+                server.close()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # ---------------------------------------------------- rebalance stanza
 
 
@@ -1981,6 +2166,7 @@ STANZAS = (
     ("SCHED", bench_sched),
     ("MIXED", bench_mixed),
     ("FAULT", bench_fault),
+    ("DEGRADE", bench_degrade),
     ("REBALANCE", bench_rebalance),
     ("TIER", bench_tier),
     ("TOPN_BSI", bench_topn_bsi),

@@ -83,6 +83,23 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                         "requests (0 disables hedging)")
     p.add_argument("--resilience-hedge-min-delay",
                    dest="resilience_hedge_min_delay", type=float)
+    p.add_argument("--resilience-device-breaker-failures",
+                   dest="resilience_device_breaker_failures", type=int,
+                   help="consecutive engine dispatch failures before the "
+                        "device plane demotes to host execution")
+    p.add_argument("--resilience-device-breaker-backoff",
+                   dest="resilience_device_breaker_backoff", type=float,
+                   help="initial open->half-open backoff in seconds for the "
+                        "device plane breaker (doubles per failed probe)")
+    p.add_argument("--resilience-device-breaker-backoff-max",
+                   dest="resilience_device_breaker_backoff_max", type=float)
+    p.add_argument("--resilience-device-sig-failures",
+                   dest="resilience_device_sig_failures", type=int,
+                   help="consecutive failures of one query signature's fused "
+                        "program before that signature is quarantined to the "
+                        "per-shard path")
+    p.add_argument("--resilience-device-sig-backoff",
+                   dest="resilience_device_sig_backoff", type=float)
     p.add_argument("--rebalance-online", dest="rebalance_online",
                    type=lambda s: s.lower() in ("1", "true", "yes"),
                    metavar="{true,false}",
@@ -177,6 +194,17 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    dest="engine_aux_memo_entries", type=int,
                    help="host composite-result memo entry budget "
                         "(0 = default)")
+    p.add_argument("--engine-dispatch-watchdog",
+                   dest="engine_dispatch_watchdog", type=float,
+                   help="seconds a device dispatch may block before the "
+                        "watchdog abandons it as a timeout fault "
+                        "(0 disables)")
+    p.add_argument("--engine-cold-host-count",
+                   dest="engine_cold_host_count", type=int,
+                   metavar="{0,1}",
+                   help="1 answers a one-off Count on fully-demoted planes "
+                        "straight from the compressed host tier (no decode "
+                        "+ device_put); 0 disables")
     p.add_argument("--tier-hbm-bytes", dest="tier_hbm_bytes", type=int,
                    help="combined device-cache budget split across the "
                         "leaf/stack caches (0 = platform default)")

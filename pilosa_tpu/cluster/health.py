@@ -73,6 +73,20 @@ class ResilienceConfig:
     # Floor/fallback for the adaptive delay: used while a peer has too few
     # latency samples for a meaningful p99, and as the minimum even after.
     hedge_min_delay: float = 0.02
+    # Device-plane breakers (parallel/device_health.py, docs/
+    # fault-tolerance.md). Consecutive engine-dispatch failures (any
+    # signature) before the PLANE breaker opens and the engine demotes to
+    # host execution; the OPEN -> HALF_OPEN backoff doubles per failed
+    # probe like the peer breaker, capped at the max. `probe_ttl` above is
+    # shared: a claimed device probe that never reports expires the same
+    # way a lost peer probe does.
+    device_breaker_failures: int = 3
+    device_breaker_backoff: float = 2.0
+    device_breaker_backoff_max: float = 60.0
+    # Consecutive failures of ONE query signature's fused program before
+    # that signature alone is quarantined to the per-shard XLA walk.
+    device_sig_failures: int = 2
+    device_sig_backoff: float = 10.0
 
     def validate(self) -> "ResilienceConfig":
         if self.breaker_failures < 1:
@@ -87,6 +101,16 @@ class ResilienceConfig:
                 "resilience.hedge-max-fraction must be in [0, 1]")
         if self.retry_budget < 0 or self.retry_refill < 0:
             raise ValueError("resilience retry knobs must be >= 0")
+        if self.device_breaker_failures < 1 or self.device_sig_failures < 1:
+            raise ValueError(
+                "resilience.device-breaker-failures / device-sig-failures "
+                "must be >= 1")
+        if self.device_breaker_backoff <= 0 or self.device_sig_backoff <= 0:
+            raise ValueError("resilience device backoffs must be > 0")
+        if self.device_breaker_backoff_max < self.device_breaker_backoff:
+            raise ValueError(
+                "resilience.device-breaker-backoff-max must be >= "
+                "device-breaker-backoff")
         return self
 
 

@@ -195,6 +195,17 @@ class Config:
             "hedge-max-fraction", self.resilience.hedge_max_fraction)
         self.resilience.hedge_min_delay = r.get(
             "hedge-min-delay", self.resilience.hedge_min_delay)
+        self.resilience.device_breaker_failures = r.get(
+            "device-breaker-failures", self.resilience.device_breaker_failures)
+        self.resilience.device_breaker_backoff = r.get(
+            "device-breaker-backoff", self.resilience.device_breaker_backoff)
+        self.resilience.device_breaker_backoff_max = r.get(
+            "device-breaker-backoff-max",
+            self.resilience.device_breaker_backoff_max)
+        self.resilience.device_sig_failures = r.get(
+            "device-sig-failures", self.resilience.device_sig_failures)
+        self.resilience.device_sig_backoff = r.get(
+            "device-sig-backoff", self.resilience.device_sig_backoff)
         rb = d.get("rebalance", {})
         self.rebalance.online = rb.get("online", self.rebalance.online)
         self.rebalance.max_concurrent_streams = rb.get(
@@ -248,6 +259,10 @@ class Config:
             "memo-entries", self.engine.memo_entries)
         self.engine.aux_memo_entries = e.get(
             "aux-memo-entries", self.engine.aux_memo_entries)
+        self.engine.dispatch_watchdog = e.get(
+            "dispatch-watchdog", self.engine.dispatch_watchdog)
+        self.engine.cold_host_count = e.get(
+            "cold-host-count", self.engine.cold_host_count)
         ti = d.get("tier", {})
         self.tier.hbm_bytes = ti.get("hbm-bytes", self.tier.hbm_bytes)
         self.tier.host_bytes = ti.get("host-bytes", self.tier.host_bytes)
@@ -324,6 +339,14 @@ class Config:
             ("hedge_delay", "RESILIENCE_HEDGE_DELAY", float),
             ("hedge_max_fraction", "RESILIENCE_HEDGE_MAX_FRACTION", float),
             ("hedge_min_delay", "RESILIENCE_HEDGE_MIN_DELAY", float),
+            ("device_breaker_failures",
+             "RESILIENCE_DEVICE_BREAKER_FAILURES", int),
+            ("device_breaker_backoff",
+             "RESILIENCE_DEVICE_BREAKER_BACKOFF", float),
+            ("device_breaker_backoff_max",
+             "RESILIENCE_DEVICE_BREAKER_BACKOFF_MAX", float),
+            ("device_sig_failures", "RESILIENCE_DEVICE_SIG_FAILURES", int),
+            ("device_sig_backoff", "RESILIENCE_DEVICE_SIG_BACKOFF", float),
         ]:
             v = env(name, cast)
             if v is not None:
@@ -374,6 +397,8 @@ class Config:
             ("stack_cache_bytes", "ENGINE_STACK_CACHE_BYTES", int),
             ("memo_entries", "ENGINE_MEMO_ENTRIES", int),
             ("aux_memo_entries", "ENGINE_AUX_MEMO_ENTRIES", int),
+            ("dispatch_watchdog", "ENGINE_DISPATCH_WATCHDOG", float),
+            ("cold_host_count", "ENGINE_COLD_HOST_COUNT", int),
         ]:
             v = env(name, cast)
             if v is not None:
@@ -432,6 +457,16 @@ class Config:
             "resilience_hedge_max_fraction":
                 ("resilience", "hedge_max_fraction"),
             "resilience_hedge_min_delay": ("resilience", "hedge_min_delay"),
+            "resilience_device_breaker_failures":
+                ("resilience", "device_breaker_failures"),
+            "resilience_device_breaker_backoff":
+                ("resilience", "device_breaker_backoff"),
+            "resilience_device_breaker_backoff_max":
+                ("resilience", "device_breaker_backoff_max"),
+            "resilience_device_sig_failures":
+                ("resilience", "device_sig_failures"),
+            "resilience_device_sig_backoff":
+                ("resilience", "device_sig_backoff"),
             "rebalance_online": ("rebalance", "online"),
             "rebalance_max_concurrent_streams":
                 ("rebalance", "max_concurrent_streams"),
@@ -463,6 +498,8 @@ class Config:
             "engine_stack_cache_bytes": ("engine", "stack_cache_bytes"),
             "engine_memo_entries": ("engine", "memo_entries"),
             "engine_aux_memo_entries": ("engine", "aux_memo_entries"),
+            "engine_dispatch_watchdog": ("engine", "dispatch_watchdog"),
+            "engine_cold_host_count": ("engine", "cold_host_count"),
             "tier_hbm_bytes": ("tier", "hbm_bytes"),
             "tier_host_bytes": ("tier", "host_bytes"),
             "tier_disk_bytes": ("tier", "disk_bytes"),
@@ -529,6 +566,11 @@ class Config:
             f"hedge-delay = {self.resilience.hedge_delay}",
             f"hedge-max-fraction = {self.resilience.hedge_max_fraction}",
             f"hedge-min-delay = {self.resilience.hedge_min_delay}",
+            f"device-breaker-failures = {self.resilience.device_breaker_failures}",
+            f"device-breaker-backoff = {self.resilience.device_breaker_backoff}",
+            f"device-breaker-backoff-max = {self.resilience.device_breaker_backoff_max}",
+            f"device-sig-failures = {self.resilience.device_sig_failures}",
+            f"device-sig-backoff = {self.resilience.device_sig_backoff}",
             "",
             "[rebalance]",
             f"online = {fmt(self.rebalance.online)}",
@@ -566,6 +608,8 @@ class Config:
             f"stack-cache-bytes = {self.engine.stack_cache_bytes}",
             f"memo-entries = {self.engine.memo_entries}",
             f"aux-memo-entries = {self.engine.aux_memo_entries}",
+            f"dispatch-watchdog = {self.engine.dispatch_watchdog}",
+            f"cold-host-count = {self.engine.cold_host_count}",
             "",
             "[tier]",
             f"hbm-bytes = {self.tier.hbm_bytes}",

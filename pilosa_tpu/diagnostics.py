@@ -107,6 +107,21 @@ class DiagnosticsCollector:
             # means HBM pressure is being absorbed by the tiers.
             info["engineLeafTierHits"] = c.get("leaf_tier_hits", 0)
             info["engineLeafMisses"] = c.get("leaf_misses", 0)
+            # Device-plane fault shape: how often dispatches failed (and
+            # how they classified), whether the plane breaker ever opened,
+            # and how much serving came off the host ladder — the
+            # aggregate story of how healthy this node's accelerator is
+            # (per-signature detail stays in /debug/vars device_plane).
+            dp = engine.device_health.snapshot()
+            info["deviceDispatchFailures"] = dp.get("dispatch_failures", 0)
+            info["deviceFailuresOom"] = dp.get("failures_oom", 0)
+            info["devicePlaneOpened"] = dp.get("plane_opened", 0)
+            info["devicePlaneState"] = dp.get("plane_state")
+            info["deviceSigQuarantined"] = dp.get("sig_quarantined", 0)
+            info["deviceHostCounts"] = c.get("host_counts", 0)
+            info["deviceHostColdCounts"] = c.get("host_cold_counts", 0)
+            info["deviceOomBackpressure"] = c.get("oom_backpressure", 0)
+            info["deviceWatchdogTimeouts"] = c.get("watchdog_timeouts", 0)
             if engine.tier is not None:
                 snap = engine.tier.snapshot()
                 info["tierHostBytes"] = snap.get("host_bytes", 0)

@@ -26,6 +26,7 @@ from .errors import (
     QueryError,
     TooManyWritesError,
 )
+from .parallel.device_health import DeviceDispatchError
 from .pql import parser as pql_parser
 from .pql.ast import BETWEEN, Call, Condition, GT, GTE, LT, LTE, NEQ
 from .timeq import parse_timestamp, views_by_time_range
@@ -178,7 +179,12 @@ class Executor:
             self._engine = ShardedQueryEngine(
                 self.holder, config=self.engine_config,
                 tier_config=self.tier_config,
-                traffic_fn=self.tier_traffic_fn)
+                traffic_fn=self.tier_traffic_fn,
+                # The device-plane breakers share the [resilience] section
+                # with the peer breakers they are modeled on; the cluster's
+                # health registry already holds the resolved config, so the
+                # lazily-built engine needs no extra plumbing.
+                resilience_config=self.cluster.health.config)
         return self._engine
 
     def close(self) -> None:
@@ -388,6 +394,13 @@ class Executor:
             return result
 
         return self._fan_out(index, shards, c, opt, local_runner, reduce_fn)
+
+    def _count_stat(self, name: str) -> None:
+        """stats.count guarded for library use (Holder(None) has no stats
+        client); the ladder counters must not be the thing that breaks a
+        degraded query."""
+        if self.holder.stats is not None:
+            self.holder.stats.count(name, 1)
 
     def _serves_shard(self, index: str, shard: int) -> bool:
         """True when this node serves (index, shard) under the CURRENT
@@ -826,21 +839,67 @@ class Executor:
     def _batched_or_map_reduce(self, index, c, shards, opt, kind, map_fn, reduce_fn, child=None):
         """Run locally-owned shards as ONE sharded device program when the
         call tree compiles onto the fast path; remote/unsupported shards use
-        the reference-style per-shard map/reduce."""
+        the reference-style per-shard map/reduce.
+
+        The device-fault ladder (docs/fault-tolerance.md) sits here: the
+        engine's breaker state routes a quarantined SIGNATURE to the
+        per-shard XLA walk and an open PLANE to host execution before any
+        device work is attempted, and a dispatch that fails mid-request
+        falls one rung down for exactly that batch instead of surfacing a
+        500 — the breakers make the routing sticky for the next query."""
         target = child if child is not None else c
         supported = self.engine.supports(target, index) if shards else False
-        if supported:
-            # supports(call, index) returns the compiled (comp, expr) pair,
-            # so the gate and the execution share one AST walk on the
-            # hottest serving path (True means a patched/syntactic gate:
-            # let the engine compile internally).
-            compiled = None if supported is True else supported
+        if not supported:
+            return self._map_reduce(index, shards, c, opt, map_fn, reduce_fn)
+        # supports(call, index) returns the compiled (comp, expr) pair,
+        # so the gate and the execution share one AST walk on the
+        # hottest serving path (True means a patched/syntactic gate:
+        # let the engine compile internally).
+        compiled = None if supported is True else supported
+        health_sig = tuple(compiled[0].signature) if compiled else None
+        route = self.engine.device_health.plan(health_sig)
+        if route == "shard":
+            # Per-signature quarantine: THIS structure keeps failing on
+            # the fused path; everything else stays on the device. The
+            # half-open probe re-admits it via plan() after backoff.
+            self._count_stat("DeviceSigQuarantined")
+            return self._map_reduce(index, shards, c, opt, map_fn, reduce_fn)
+        if route == "host":
+            # Plane breaker open: the device is sick — no dispatches at
+            # all. Counts answer compressed-domain from the host ladder;
+            # trees the host evaluator can't express (BSI) take the
+            # per-shard walk.
+            self._count_stat("DeviceHostRouted")
+            if kind == "count" and self.engine.host_supports(target):
 
-            def local_runner(local_shards):
-                if opt.deadline is not None:
-                    # "Aborts before the next device dispatch": the gate
-                    # sits exactly at the engine-launch boundary.
-                    opt.deadline.check("device dispatch")
+                def host_runner(local_shards):
+                    if opt.deadline is not None:
+                        opt.deadline.check("host execution")
+                    return self.engine.host_count(
+                        index, target, local_shards, comp_expr=compiled)
+
+                return self._fan_out(
+                    index, shards, c, opt, host_runner, reduce_fn)
+            return self._map_reduce(index, shards, c, opt, map_fn, reduce_fn)
+
+        def fallback(local_shards):
+            # One rung down for THIS batch: the breaker state decides
+            # where the NEXT query routes; this query still answers.
+            if kind == "count" and self.engine.host_supports(target):
+                return self.engine.host_count(
+                    index, target, local_shards, comp_expr=compiled)
+            result = None
+            for s in local_shards:
+                v = map_fn(s)
+                result = v if result is None else reduce_fn(result, v)
+            return result
+
+        def local_runner(local_shards):
+            if opt.deadline is not None:
+                # "Aborts before the next device dispatch": the gate
+                # sits exactly at the engine-launch boundary.
+                opt.deadline.check("device dispatch")
+            try:
                 if kind == "count":
                     if self.batcher is not None:
                         return self.batcher.count(
@@ -850,9 +909,14 @@ class Executor:
                         index, target, local_shards, comp_expr=compiled)
                 return self.engine.bitmap(
                     index, target, local_shards, comp_expr=compiled)
+            except DeviceDispatchError as e:
+                self._count_stat("DeviceLadderFallback")
+                self.logger.error(
+                    "device dispatch failed (%s), serving %s from the "
+                    "fallback rung: %s", e.kind, kind, e)
+                return fallback(local_shards)
 
-            return self._fan_out(index, shards, c, opt, local_runner, reduce_fn)
-        return self._map_reduce(index, shards, c, opt, map_fn, reduce_fn)
+        return self._fan_out(index, shards, c, opt, local_runner, reduce_fn)
 
     # --------------------------------------------------------- sum/min/max
 
@@ -896,15 +960,36 @@ class Executor:
         local_runner = None
         if bsig is not None and (
             filter_call is None or self.engine.supports(filter_call, index)
-        ):
+        ) and self.engine.device_health.plan(None) == "device":
             # Batched path: one device program per node covering all its
-            # shards (replaces the per-shard ValCount merge loop).
+            # shards (replaces the per-shard ValCount merge loop). An
+            # open plane breaker short-circuits to the per-shard walk
+            # BEFORE any dispatch — BSI's bit-sliced kernels have no host
+            # twin, so rung 1 is its whole degraded ladder, and paying a
+            # failing dispatch (or a watchdog stall) per query on a known-
+            # sick device would defeat the breaker.
             depth = bsig.bit_depth()
 
             def local_runner(local_shards):
-                out = self.engine.bsi_val_count(
-                    index, field_name, kind, depth, local_shards, filter_call
-                )
+                try:
+                    out = self.engine.bsi_val_count(
+                        index, field_name, kind, depth, local_shards,
+                        filter_call
+                    )
+                except DeviceDispatchError as e:
+                    # Ladder rung for BSI: the bit-sliced scan is device
+                    # code with no host twin, so the fallback is the
+                    # reference per-shard merge for this batch (the
+                    # breaker reroutes subsequent queries).
+                    self._count_stat("DeviceLadderFallback")
+                    self.logger.error(
+                        "device BSI dispatch failed (%s), per-shard "
+                        "fallback: %s", e.kind, e)
+                    result = None
+                    for s in local_shards:
+                        v = map_fn(s)
+                        result = v if result is None else reduce_fn(result, v)
+                    return result
                 return self._compose_bsi_result(bsig, kind, out)
 
         if local_runner is not None:
@@ -969,6 +1054,51 @@ class Executor:
 
     # ----------------------------------------------------------------- TopN
 
+    def _check_chunk_deadline(self, deadline, where: str) -> None:
+        """Deadline re-check BETWEEN device-dispatch chunks and after
+        gathers: the scheduler gates the budget before a dispatch, but a
+        multi-chunk TopN would otherwise finish dead work after the
+        budget expires mid-flight. The counter separates 'expired between
+        chunks' (work was abandoned early, the good case) from the
+        admission-time expiries the scheduler already counts."""
+        if deadline is None:
+            return
+        if deadline.expired():
+            self._count_stat("DeadlineMidQuery")
+        deadline.check(where)
+
+    def _topn_counts_laddered(self, index, field, ids, local_shards,
+                              src_call, need_rc):
+        """engine.topn_shard_counts under the device-fault ladder: an
+        open plane breaker (or a dispatch failure mid-request) answers
+        the same contract from host planes + numpy popcounts instead of
+        erroring (docs/fault-tolerance.md). When the src tree has no
+        host twin (BSI Range), a DeviceDispatchError propagates — the
+        batched local_runners catch it and take the per-shard rung."""
+        eng = self.engine
+        host_ok = src_call is None or eng.host_supports(src_call)
+        if eng.device_health.plan(None) == "device":
+            try:
+                return eng.topn_shard_counts(
+                    index, field, ids, local_shards, src_call,
+                    need_row_counts=need_rc)
+            except DeviceDispatchError as e:
+                if not host_ok:
+                    raise
+                self._count_stat("DeviceLadderFallback")
+                self.logger.error(
+                    "device TopN dispatch failed (%s), host fallback: %s",
+                    e.kind, e)
+        elif not host_ok:
+            raise DeviceDispatchError(
+                "runtime", None,
+                "device plane degraded and TopN src is not host-executable")
+        else:
+            self._count_stat("DeviceHostRouted")
+        return eng.host_topn_shard_counts(
+            index, field, ids, local_shards, src_call,
+            need_row_counts=need_rc)
+
     def _execute_topn(self, index: str, c: Call, shards: List[int], opt: ExecOptions) -> List[Pair]:
         ids_arg = self._uint_slice_arg(c, "ids")
         n, _ = c.uint_arg("n")
@@ -978,7 +1108,10 @@ class Executor:
             return pairs
 
         # Phase 2: refetch full counts for the merged candidate ids
-        # (executor.go:524-560).
+        # (executor.go:524-560). Re-check the budget first: phase 1's
+        # gathers may have consumed it, and phase 2 is a full second
+        # fan-out of dead work if so.
+        self._check_chunk_deadline(opt.deadline, "between TopN phases")
         other = Call(c.name, dict(c.args), list(c.children))
         other.args["ids"] = sorted({p.id for p in pairs})
         trimmed = self._execute_topn_shards(index, other, shards, opt)
@@ -1016,6 +1149,9 @@ class Executor:
                 pairs: List[Pair] = []
                 CHUNK = _topn_chunk(len(shards))  # bounds the (R, S, W) global stack
                 for i in range(0, len(ids), CHUNK):
+                    if i:
+                        self._check_chunk_deadline(
+                            opt.deadline, "between collective TopN chunks")
                     chunk = ids[i : i + CHUNK]
                     counts = self.collective.topn_counts(
                         index, field_name, chunk, src_call
@@ -1066,9 +1202,9 @@ class Executor:
                 # common phase-2 skips the candidate-plane popcount pass
                 # entirely (engine.topn_shard_counts need_row_counts).
                 need_rc = bool(tanimoto) or thr > 1
-                row_counts, inter, src_counts = self.engine.topn_shard_counts(
+                row_counts, inter, src_counts = self._topn_counts_laddered(
                     index, field_name, run_ids, local_shards, src_call,
-                    need_row_counts=need_rc,
+                    need_rc,
                 )
                 pairs: Dict[int, int] = {}
                 for ri, row_id in enumerate(run_ids):
@@ -1140,13 +1276,19 @@ class Executor:
                 src_count_by_shard: Dict[int, int] = {}
                 CHUNK = _topn_chunk(len(shard_list))  # bounds the gather working set
                 for i in range(0, len(union), CHUNK):
+                    if i:
+                        # Between chunks AND after the previous chunk's
+                        # gather: a budget that died mid-TopN stops here
+                        # (503) instead of finishing dead device work.
+                        self._check_chunk_deadline(
+                            opt.deadline, "between TopN chunks")
                     chunk = union[i : i + CHUNK]
                     # Ranking uses the cache counts already attached to the
                     # candidates; the device program only computes the src
                     # intersections (need_row_counts=False).
-                    _, inter, src_counts = self.engine.topn_shard_counts(
+                    _, inter, src_counts = self._topn_counts_laddered(
                         index, field_name, chunk, shard_list, src_call,
-                        need_row_counts=False,
+                        False,
                     )
                     for si, s in enumerate(shard_list):
                         src_count_by_shard[s] = int(src_counts[si])
@@ -1165,7 +1307,26 @@ class Executor:
                 return add_pairs([], out)
 
         if local_runner is not None:
-            result = self._fan_out(index, shards, c, opt, local_runner, add_pairs) or []
+            # Last rung for a batch neither the device nor the host
+            # evaluator could serve (e.g. degraded plane + BSI src): the
+            # reference per-shard TopN walk, same one _map_reduce runs.
+            batched_runner = local_runner
+
+            def guarded_runner(local_shards):
+                try:
+                    return batched_runner(local_shards)
+                except DeviceDispatchError as e:
+                    self._count_stat("DeviceLadderFallback")
+                    self.logger.error(
+                        "batched TopN unavailable (%s), per-shard rung: %s",
+                        e.kind, e)
+                    out = []
+                    for s in local_shards:
+                        out = add_pairs(out, map_fn(s))
+                    return out
+
+            result = self._fan_out(
+                index, shards, c, opt, guarded_runner, add_pairs) or []
         else:
             result = self._map_reduce(index, shards, c, opt, map_fn, add_pairs) or []
         return sort_pairs(result)

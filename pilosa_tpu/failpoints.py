@@ -20,7 +20,12 @@ Spec grammar per point: `[count*]action[(arg)]` where action is
   drop         raise InjectedFault styled as a dropped connection — the
                network blackhole action (the client classifies it as a
                transport failure, status 0)
-  latency(ms)  sleep `ms` milliseconds, then continue (slow network)
+  oom          raise InjectedFault styled as an HBM RESOURCE_EXHAUSTED —
+               the device-plane action: the engine's error classifier
+               (parallel/device_health.py) reads it as OOM and runs the
+               backpressure path a real allocation failure would
+  latency(ms)  sleep `ms` milliseconds, then continue (slow network /
+               wedged device dispatch — pairs with the dispatch watchdog)
   flaky(p)     with probability `p` (0..1) behave like `drop`, else pass;
                draws come from a module RNG seeded by seed() /
                PILOSA_TPU_FAILPOINTS_SEED so chaos runs are reproducible
@@ -97,7 +102,7 @@ import random as _random  # noqa: E402
 _rng = _random.Random(0)
 
 _SPEC_RE = re.compile(
-    r"^(?:(?P<count>\d+)\*)?(?P<action>error|crash|drop|latency|flaky)"
+    r"^(?:(?P<count>\d+)\*)?(?P<action>error|crash|drop|oom|latency|flaky)"
     r"(?:\((?P<msg>[^)]*)\))?$"
 )
 
@@ -144,6 +149,14 @@ def _fire_slow(name: str, target: Optional[str] = None) -> None:
     if action in ("drop", "flaky"):
         raise InjectedFault(
             message or f"injected network drop at failpoint {hit_name!r}")
+    if action == "oom":
+        # The RESOURCE_EXHAUSTED spelling is load-bearing: it is what the
+        # device-plane classifier keys on, so the injected fault takes the
+        # same backpressure path a real HBM allocation failure would. A
+        # custom message rides BEHIND the prefix — replacing it would
+        # silently turn an OOM-rung test into a generic-failure test.
+        detail = message or f"injected HBM OOM at failpoint {hit_name!r}"
+        raise InjectedFault(f"RESOURCE_EXHAUSTED: {detail}")
     raise InjectedFault(message or f"injected fault at failpoint {hit_name!r}")
 
 
@@ -152,7 +165,7 @@ def configure(name: str, action: str, count: Optional[int] = None,
     """Register (or replace) one failpoint programmatically. For network
     actions `arg` is the latency in ms (latency) or the failure
     probability (flaky)."""
-    if action not in ("error", "crash", "drop", "latency", "flaky"):
+    if action not in ("error", "crash", "drop", "oom", "latency", "flaky"):
         raise ValueError(f"unknown failpoint action {action!r}")
     if action == "flaky" and not 0.0 <= arg <= 1.0:
         raise ValueError("flaky probability must be in [0, 1]")

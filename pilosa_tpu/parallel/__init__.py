@@ -40,3 +40,18 @@ class EngineConfig:
     stack_cache_bytes: int = 0
     memo_entries: int = 0
     aux_memo_entries: int = 0
+    # Device-fault handling (docs/fault-tolerance.md, device-plane
+    # section). dispatch_watchdog: seconds a device dispatch may block
+    # before the watchdog frees the serving thread and the failure is
+    # classified `timeout` into the device breakers (0 disables; the
+    # wedged dispatch itself cannot be killed — it parks a worker of the
+    # engine's dedicated 4-slot dispatch pool until the runtime answers,
+    # and once every slot is parked further dispatches run inline
+    # unwatchdogged). cold_host_count: 1 answers a one-off Count whose
+    # leaves are ALL demoted to the host tier directly from the
+    # compressed bytes in one numpy pass — no decode + device_put for a
+    # plane nobody re-reads (ROADMAP compressed-domain execution); the
+    # SECOND touch of the same leaf set promotes normally so hot planes
+    # still climb back into HBM. 0 disables.
+    dispatch_watchdog: float = 0.0
+    cold_host_count: int = 1

@@ -24,13 +24,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import failpoints
 from ..constants import VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD, WORDS_PER_ROW
 from ..core.row import Row
 from ..errors import FieldNotFoundError, BSIGroupNotFoundError, QueryError
 from ..ops import bitplane as bp
 from ..pql.ast import BETWEEN, Call, GT, GTE, LT, LTE, NEQ
 from . import EngineConfig
+from .device_health import (
+    COMPILE, DeviceDispatchError, DeviceDispatchTimeout, DevicePlaneHealth,
+    OOM, classify_device_error,
+)
 from .mesh import SHARD_AXIS, default_mesh, pad_shards, shard_sharding
+
+def _pop_elems(a: np.ndarray) -> np.ndarray:
+    """Elementwise popcounts of a uint32 array for the host execution
+    ladder, returned over the uint16 view (same leading shape, last axis
+    doubled) so callers sum over the trailing axis/axes for plane
+    popcounts. np.bitwise_count is used unconditionally, matching the
+    storage/wire layers (storage/bitmap.py, server/wire.py)."""
+    return np.bitwise_count(a.view(np.uint16))
 
 
 class Leaf(NamedTuple):
@@ -41,6 +54,39 @@ class Leaf(NamedTuple):
     field: str
     view: str
     row: int
+
+
+def _resolve_time_range(holder, index: str, c: Call):
+    """(field_name, row_id, present views) for a time-quantum Range call
+    — THE one implementation of the argument parsing and present-view
+    pruning, shared by the compiled fast path and the host evaluator.
+    The degraded host answer must match the compiled program bit for
+    bit, so the view set they union over cannot be allowed to diverge."""
+    from ..timeq import parse_timestamp, views_by_time_range
+
+    field_name = c.field_arg()
+    fld = holder.field(index, field_name)
+    if fld is None:
+        raise FieldNotFoundError(field_name)
+    row_id, ok = c.uint_arg(field_name)
+    if not ok:
+        raise QueryError("Range() must specify row")
+    start = c.args.get("_start")
+    end = c.args.get("_end")
+    if not isinstance(start, str) or not isinstance(end, str):
+        raise QueryError("Range() start/end time required")
+    q = fld.time_quantum()
+    if not q:
+        raise QueryError("Range() field has no time quantum")
+    views = views_by_time_range(
+        VIEW_STANDARD, parse_timestamp(start), parse_timestamp(end), q
+    )
+    # Prune to views that exist in the field: an hour-quantum range
+    # over years enumerates tens of thousands of view names, and a
+    # leaf per ABSENT view would materialize a zero plane per shard
+    # (the per-shard fallback just skips missing fragments). Present
+    # views bound the work to actual data.
+    return field_name, row_id, [v for v in views if fld.view(v) is not None]
 
 
 class _Compiler:
@@ -123,33 +169,11 @@ class _Compiler:
         executor.go:executeBitmapCallShard + fragment row per view); here
         the whole view set becomes leaf planes of ONE compiled program, so
         Count(Range(t=...)) over all shards is a single device dispatch
-        and composes with Intersect/Union/TopN-src like any other leaf."""
-        from ..timeq import parse_timestamp, views_by_time_range
-
-        field_name = c.field_arg()
-        fld = self.holder.field(self.index, field_name)
-        if fld is None:
-            raise FieldNotFoundError(field_name)
-        row_id, ok = c.uint_arg(field_name)
-        if not ok:
-            raise QueryError("Range() must specify row")
-        start = c.args.get("_start")
-        end = c.args.get("_end")
-        if not isinstance(start, str) or not isinstance(end, str):
-            raise QueryError("Range() start/end time required")
-        q = fld.time_quantum()
-        if not q:
-            raise QueryError("Range() field has no time quantum")
-        views = views_by_time_range(
-            VIEW_STANDARD, parse_timestamp(start), parse_timestamp(end), q
-        )
-        # Prune to views that exist in the field: an hour-quantum range
-        # over years enumerates tens of thousands of view names, and a
-        # leaf per ABSENT view would materialize a zero plane per shard
-        # (the per-shard fallback just skips missing fragments). Present
-        # views bound the work to actual data; an empty result refuses so
-        # supports() sends the executor down the fallback.
-        views = [v for v in views if fld.view(v) is not None]
+        and composes with Intersect/Union/TopN-src like any other leaf.
+        An empty pruned view set refuses so supports() sends the executor
+        down the fallback."""
+        field_name, row_id, views = _resolve_time_range(
+            self.holder, self.index, c)
         if not views:
             raise QueryError("Range() covers no populated views")
         if len(views) > 256:
@@ -227,7 +251,7 @@ class _Compiler:
 
 class ShardedQueryEngine:
     def __init__(self, holder, mesh=None, config: Optional[EngineConfig] = None,
-                 tier_config=None, traffic_fn=None):
+                 tier_config=None, traffic_fn=None, resilience_config=None):
         self.holder = holder
         self.mesh = mesh if mesh is not None else default_mesh()
         if config is None:
@@ -251,6 +275,12 @@ class ShardedQueryEngine:
                     "PILOSA_TPU_ENGINE_MEMO_ENTRIES", 0)),
                 aux_memo_entries=int(os.environ.get(
                     "PILOSA_TPU_ENGINE_AUX_MEMO_ENTRIES", 0)),
+                dispatch_watchdog=float(os.environ.get(
+                    "PILOSA_TPU_ENGINE_DISPATCH_WATCHDOG",
+                    EngineConfig.dispatch_watchdog)),
+                cold_host_count=int(os.environ.get(
+                    "PILOSA_TPU_ENGINE_COLD_HOST_COUNT",
+                    EngineConfig.cold_host_count)),
             )
         if tier_config is None:
             # Same env-only fallback for the [tier] section.
@@ -261,6 +291,29 @@ class ShardedQueryEngine:
         # scattered (indices, values) upload only while the changed 32-bit
         # words stay under this fraction of the tensor; 0 disables deltas.
         self._delta_max_fraction = float(config.delta_max_fraction)
+        # Device-plane fault state (device_health.py): every dispatch
+        # reports its outcome here, and the executor consults plan()
+        # before routing work at the device. The watchdog bounds how long
+        # a dispatch may block a serving thread (0 = off).
+        self.device_health = DevicePlaneHealth(resilience_config)
+        self._watchdog_s = float(getattr(config, "dispatch_watchdog", 0.0))
+        # Watchdogged dispatches run on their own small pool, NOT the
+        # gather pool: an abandoned (wedged) dispatch parks its worker
+        # until the runtime answers, and parking gather workers would
+        # starve the host gathers the fallback ladder itself serves
+        # from. `_watchdog_inflight` counts submitted-but-unfinished
+        # dispatches (incremented at submit, decremented by a done
+        # callback); at the pool bound, further dispatches run INLINE
+        # unwatchdogged — slower to detect a wedge, but never a deadlock
+        # and never a queued task misread as a device timeout.
+        self._watchdog_pool = None
+        self._watchdog_inflight = 0
+        self._cold_host = bool(int(getattr(config, "cold_host_count", 1)))
+        # Leaf sets already answered once by the cold-host path: the
+        # second touch promotes normally so repeat traffic climbs back
+        # into HBM instead of re-decoding per query. Bounded crudely —
+        # losing the set only costs one extra host answer per leaf set.
+        self._cold_seen: set = set()
         # Cold-gather host parallelism (per-shard container walks).
         gw = int(config.gather_workers)
         self._gather_workers = gw if gw > 0 else min(8, os.cpu_count() or 1)
@@ -374,6 +427,18 @@ class ShardedQueryEngine:
             # so the COUNT is the only externally visible trace.
             "tier_promote_errors": 0, "tier_demote_errors": 0,
             "compile_gate_refusals": 0,
+            # Device-fault ladder accounting (docs/fault-tolerance.md):
+            # host_counts/host_topn are queries answered entirely on the
+            # host (degraded ladder), host_cold_counts the healthy
+            # compressed-domain path for one-off queries on demoted
+            # planes; oom_backpressure counts budget shrinks, oom_retries
+            # dispatches that succeeded after one, oom_batch_splits
+            # reduced-batch retries, watchdog_timeouts dispatches the
+            # watchdog abandoned, device_dispatch_errors every classified
+            # dispatch failure (per-kind detail in device_plane).
+            "host_counts": 0, "host_topn": 0, "host_cold_counts": 0,
+            "oom_backpressure": 0, "oom_retries": 0, "oom_batch_splits": 0,
+            "watchdog_timeouts": 0, "device_dispatch_errors": 0,
         }
         # Tier manager (tier/manager.py): owns the host-RAM + disk tiers
         # below the device caches. Leaf evictions demote through it and
@@ -419,8 +484,11 @@ class ShardedQueryEngine:
             self.tier.close()
         with self._lock:
             pool, self._gather_pool = self._gather_pool, None
+            wpool, self._watchdog_pool = self._watchdog_pool, None
         if pool is not None:
             pool.shutdown(wait=False)
+        if wpool is not None:
+            wpool.shutdown(wait=False)
 
     # ----------------------------------------------------- tier integration
     #
@@ -528,13 +596,32 @@ class ShardedQueryEngine:
             return fn
 
     def _fn_build(self, cache: Dict[Tuple, Callable], sig: Tuple,
-                  build: Callable[[], Callable]) -> Callable:
-        """Get-or-build a compiled program, stampede-gated and LRU-bounded."""
+                  build: Callable[[], Callable],
+                  health_sig: Optional[Tuple] = None) -> Callable:
+        """Get-or-build a compiled program, stampede-gated and LRU-bounded.
+
+        A build failure is a DEVICE fault, not a query error: it is
+        classified `compile`, recorded into the device breakers under the
+        caller's structure signature (a shape whose program cannot build
+        will fail every time — quarantining it to the per-shard path is
+        exactly the breaker's job), and re-raised typed so the executor's
+        ladder catches it. The `device-compile` failpoint makes the path
+        deterministically testable; it fires only on a real cache miss,
+        like a real compile failure would."""
         fn = self._gate(sig, lambda: self._fn_probe(cache, sig))
         if fn is not None:
             return fn
         try:
-            fn = build()
+            try:
+                failpoints.fire("device-compile")
+                fn = build()
+            except Exception as e:
+                with self._lock:
+                    self.counters["device_dispatch_errors"] += 1
+                self.device_health.record_failure(health_sig, COMPILE)
+                raise DeviceDispatchError(
+                    COMPILE, health_sig,
+                    f"device program build failed: {e}") from e
             with self._lock:
                 cache[sig] = fn
                 while len(cache) > self._fn_budget:
@@ -542,6 +629,162 @@ class ShardedQueryEngine:
         finally:
             self._release(sig)
         return fn
+
+    # ------------------------------------------------------ dispatch guard
+    #
+    # Every device dispatch runs through _device_call: the `device-
+    # dispatch` failpoint fires at exactly this boundary, the optional
+    # watchdog bounds how long the serving thread blocks, failures are
+    # classified (device_health.classify_device_error) and recorded into
+    # the per-signature + plane breakers, and an HBM OOM gets
+    # backpressure (shrink budgets, demote through the tier manager) plus
+    # ONE same-size retry before the typed error escapes to the
+    # executor's ladder. Gather-stage transfers use the lighter
+    # _oom_guard: same backpressure, but non-OOM errors propagate raw
+    # (a gather bug must not masquerade as a dispatch fault).
+
+    _WATCHDOG_WORKERS = 4
+
+    def _watchdog_done(self, _fut) -> None:
+        with self._lock:
+            self._watchdog_inflight -= 1
+
+    def _watchdogged(self, fn: Callable, fire: bool = True):
+        def run():
+            if fire:
+                failpoints.fire("device-dispatch")
+            return fn()
+
+        if self._watchdog_s <= 0:
+            return run()
+        with self._lock:
+            if self._watchdog_inflight >= self._WATCHDOG_WORKERS:
+                # Every watchdog slot is occupied (normally: parked on
+                # wedged dispatches). Dispatch inline unwatchdogged —
+                # the breaker still routes around repeated failures; we
+                # just can't bound this one call's latency. Submitting
+                # instead would queue the task and misread queue delay
+                # as a device timeout.
+                inline = True
+            else:
+                if self._watchdog_pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    self._watchdog_pool = ThreadPoolExecutor(
+                        max_workers=self._WATCHDOG_WORKERS,
+                        thread_name_prefix="pilosa-dispatch",
+                    )
+                self._watchdog_inflight += 1
+                inline = False
+                pool = self._watchdog_pool
+        if inline:
+            return run()
+        from concurrent.futures import TimeoutError as FutTimeout
+
+        fut = pool.submit(run)
+        # Fires when the task finishes, is cancelled, or (wedged case)
+        # whenever the runtime finally answers — inflight stays elevated
+        # exactly while a worker is actually occupied.
+        fut.add_done_callback(self._watchdog_done)
+        try:
+            return fut.result(timeout=self._watchdog_s)
+        except FutTimeout:
+            if fut.cancel():
+                # Never started: the timeout measured pool queueing, not
+                # the device. Not a fault — dispatch inline.
+                return run()
+            # Started and wedged: the task cannot be killed — it keeps
+            # its worker parked until the runtime answers. The watchdog
+            # frees the SERVING thread; the breaker stops new work from
+            # piling onto a wedged device.
+            with self._lock:
+                self.counters["watchdog_timeouts"] += 1
+            raise DeviceDispatchTimeout(
+                f"device dispatch exceeded the {self._watchdog_s:.3f}s "
+                "watchdog")
+
+    def _device_call(self, health_sig: Optional[Tuple], fn: Callable,
+                     fire: bool = True):
+        """Run one device dispatch under the fault ladder; returns fn()'s
+        value. On failure: classify, record into the breakers, re-raise
+        as DeviceDispatchError (the executor's catch point). OOM gets
+        backpressure + one retry first — a transient allocation failure
+        must never reach a client."""
+        try:
+            result = self._watchdogged(fn, fire=fire)
+        except Exception as e:
+            with self._lock:
+                self.counters["device_dispatch_errors"] += 1
+            kind = classify_device_error(e)
+            if kind == OOM:
+                self._oom_backpressure()
+                try:
+                    result = self._watchdogged(fn, fire=fire)
+                except Exception as e2:
+                    kind2 = classify_device_error(e2)
+                    self.device_health.record_failure(health_sig, kind2)
+                    raise DeviceDispatchError(
+                        kind2, health_sig, str(e2)) from e2
+                with self._lock:
+                    self.counters["oom_retries"] += 1
+                self.device_health.record_success(health_sig)
+                return result
+            self.device_health.record_failure(health_sig, kind)
+            raise DeviceDispatchError(kind, health_sig, str(e)) from e
+        self.device_health.record_success(health_sig)
+        return result
+
+    def _oom_guard(self, health_sig: Optional[Tuple], fn: Callable):
+        """Gather-stage transfer guard (device_put, restack): an HBM OOM
+        gets backpressure + one retry; any other failure is a DEVICE
+        fault at transfer time (dead tunnel erroring in device_put) — it
+        is classified, recorded into the breakers, and re-raised typed so
+        the executor's ladder catches it. Without that, a device that
+        dies at the transfer stage would 500 every query forever with the
+        plane breaker still CLOSED."""
+        try:
+            return fn()
+        except Exception as e:
+            with self._lock:
+                self.counters["device_dispatch_errors"] += 1
+            kind = classify_device_error(e)
+            if kind != OOM:
+                self.device_health.record_failure(health_sig, kind)
+                raise DeviceDispatchError(kind, health_sig, str(e)) from e
+            self._oom_backpressure()
+            try:
+                return fn()
+            except Exception as e2:
+                kind = classify_device_error(e2)
+                self.device_health.record_failure(health_sig, kind)
+                raise DeviceDispatchError(
+                    kind, health_sig, str(e2)) from e2
+
+    def _oom_backpressure(self) -> None:
+        """HBM pressure response: halve the effective leaf/stack budgets
+        (floored at 1 MiB), evict down to them, and demote the evicted
+        planes through the tier manager — free real HBM before the retry
+        instead of bouncing RESOURCE_EXHAUSTED to the client. The shrink
+        is sticky (the budget stays down for the process lifetime): an
+        OOM means the configured budget overcommitted this chip."""
+        evicted: List = []
+        with self._lock:
+            self.counters["oom_backpressure"] += 1
+            floor = 1 << 20
+            self._leaf_budget = max(self._leaf_budget // 2, floor)
+            self._stack_budget = max(self._stack_budget // 2, floor)
+            self.budgets["leaf_cache_bytes"] = self._leaf_budget
+            self.budgets["stack_cache_bytes"] = self._stack_budget
+            while self._leaf_bytes > self._leaf_budget and self._leaf_cache:
+                key = next(iter(self._leaf_cache))
+                self._leaf_bytes -= self._leaf_cache.pop(key)[1].nbytes
+                self.counters["leaf_evictions"] += 1
+                evicted.append(key)
+            while self._stack_bytes > self._stack_budget and self._stack_cache:
+                key = next(iter(self._stack_cache))
+                self._stack_bytes -= self._stack_cache.pop(key)[1].nbytes
+                self.counters["stack_evictions"] += 1
+        self._demote_keys(evicted)
 
     def _byte_cache_put(self, cache: Dict, key, entry: Tuple, budget: int,
                         used: int, evict_counter: str = "",
@@ -647,7 +890,8 @@ class ShardedQueryEngine:
             tier_hit = buf is not None
             if buf is None:
                 buf = self._host_gather(frags, leaf.row, s_padded)
-            arr = jax.device_put(buf, shard_sharding(self.mesh, 2))
+            arr = self._oom_guard(None, lambda: jax.device_put(
+                buf, shard_sharding(self.mesh, 2)))
             with self._lock:
                 if tier_hit:
                     self.counters["leaf_tier_hits"] += 1
@@ -932,7 +1176,7 @@ class ShardedQueryEngine:
                         out_shardings=shard_sharding(self.mesh, 3, axis=1),
                     )
                 stack_jit = self._stack_jit
-            stacked = stack_jit(tuple(arrs))
+            stacked = self._oom_guard(None, lambda: stack_jit(tuple(arrs)))
             with self._lock:
                 self.counters["stack_misses"] += 1
                 self._stack_bytes = self._byte_cache_put(
@@ -1028,6 +1272,193 @@ class ShardedQueryEngine:
                 self._aux_memo.pop(next(iter(self._aux_memo)))
                 self.counters["aux_evictions"] += 1
 
+    # ------------------------------------------------------ host execution
+    #
+    # The bottom rung of the degraded ladder (docs/fault-tolerance.md) and
+    # ROADMAP's compressed-domain cold path, one implementation: evaluate
+    # a set-op call tree entirely on the host — planes come from the
+    # host-tier compressed roaring bytes (decode_plane_words + journal
+    # fold, via TierManager.promote) when the plane is demoted, or a live
+    # container walk otherwise, and popcounts are one vectorized numpy
+    # pass. Bit-exact vs the device path by construction: the promotion
+    # logic is the same one the device gather consumes, and a popcount is
+    # a popcount. No device work whatsoever, so a dead/demoted device
+    # plane can still answer Count/TopN correctly.
+
+    def host_supports(self, call: Call) -> bool:
+        """True when `call` is answerable by the host evaluator: Row /
+        Intersect / Union / Difference / Xor trees and time-quantum
+        Ranges. BSI Ranges refuse (the bit-sliced kernels are device
+        code); the executor's ladder uses the per-shard walk for those."""
+        if call.name == "Row":
+            return True
+        if call.name in ("Intersect", "Union", "Difference", "Xor"):
+            return bool(call.children) and all(
+                self.host_supports(ch) for ch in call.children)
+        if call.name == "Range" and not call.has_condition_arg():
+            return True
+        return False
+
+    def _host_plane(self, index: str, leaf: Leaf, shards: Tuple[int, ...],
+                    cache: Optional[Dict] = None) -> np.ndarray:
+        """(len(shards), W) uint32 plane for one leaf, host memory only:
+        tier promotion (compressed decode + journal fold) when demoted,
+        live container walk otherwise. `cache` dedupes leaves within one
+        query tree."""
+        key = (index, leaf, shards)
+        if cache is not None and key in cache:
+            return cache[key]
+        frags = [
+            self.holder.fragment(index, leaf.field, leaf.view, s)
+            for s in shards
+        ]
+        fp = tuple(
+            -1 if f is None else (f.incarnation, f.generation) for f in frags)
+        buf = None
+        if self.tier is not None:
+            buf = self.tier.promote(key, frags, fp, len(shards))
+        if buf is None:
+            buf = self._host_gather(frags, leaf.row, len(shards))
+        if cache is not None:
+            cache[key] = buf
+        return buf
+
+    def _host_eval(self, index: str, call: Call, shards: Tuple[int, ...],
+                   cache: Dict) -> np.ndarray:
+        """Evaluate a host-supported call tree to its (S, W) plane."""
+        if call.name == "Row":
+            field_name = call.field_arg()
+            row_id, ok = call.uint_arg(field_name)
+            if not ok:
+                raise QueryError("Row() must specify row")
+            return self._host_plane(
+                index, Leaf(field_name, VIEW_STANDARD, row_id), shards, cache)
+        if call.name in ("Intersect", "Union", "Difference", "Xor"):
+            if not call.children:
+                raise QueryError(
+                    f"empty {call.name} query is currently not supported")
+            out = self._host_eval(index, call.children[0], shards, cache)
+            op = {
+                "Intersect": np.bitwise_and,
+                "Union": np.bitwise_or,
+                "Xor": np.bitwise_xor,
+            }.get(call.name)
+            for ch in call.children[1:]:
+                rhs = self._host_eval(index, ch, shards, cache)
+                if op is None:  # Difference
+                    out = np.bitwise_and(out, np.bitwise_not(rhs))
+                else:
+                    out = op(out, rhs)
+            return out
+        if call.name == "Range" and not call.has_condition_arg():
+            return self._host_time_range(index, call, shards, cache)
+        raise QueryError(f"not host-executable: {call.name}")
+
+    def _host_time_range(self, index: str, c: Call, shards: Tuple[int, ...],
+                         cache: Dict) -> np.ndarray:
+        """Time-quantum Range as a host union over present time views —
+        the SHARED _resolve_time_range pruning, so the host answer
+        matches the compiled program bit for bit. (This path is reached
+        only after the compiled twin accepted the call, so the empty /
+        too-many-views refusals don't re-apply here: zeros for empty is
+        exactly the fallback's semantics.)"""
+        field_name, row_id, views = _resolve_time_range(
+            self.holder, index, c)
+        out = None
+        for v in views:
+            p = self._host_plane(
+                index, Leaf(field_name, v, row_id), shards, cache)
+            out = p if out is None else np.bitwise_or(out, p)
+        if out is None:
+            out = np.zeros((len(shards), WORDS_PER_ROW), dtype=np.uint32)
+        return out
+
+    def host_count(self, index: str, call: Call, shards: Sequence[int],
+                   comp_expr=None) -> int:
+        """Count(call) answered entirely from host memory — the degraded
+        ladder's bottom rung. Shares the generation-checked result memo
+        with the device path (the answer is bit-exact, so a host-computed
+        entry is as good as a device-computed one)."""
+        shards = tuple(shards)
+        comp = None
+        if comp_expr is not None and comp_expr is not True:
+            comp = comp_expr[0]
+        if comp is None:
+            comp, _ = self._compile(index, call)
+        hit, token = self.memo_probe(index, comp, shards)
+        if hit is not None:
+            return hit
+        plane = self._host_eval(index, call, shards, {})
+        result = int(_pop_elems(plane).sum())
+        with self._lock:
+            self.counters["host_counts"] += 1
+        self.memo_store(token, result)
+        return result
+
+    def host_topn_shard_counts(
+        self, index: str, field: str, row_ids: Sequence[int],
+        shards: Sequence[int], src_call: Optional[Call] = None,
+        need_row_counts: bool = True,
+    ):
+        """topn_shard_counts with the same result contract, computed from
+        host planes with numpy popcounts — the TopN rung of the ladder.
+        Unmemoized: this is the degraded path, correctness over speed."""
+        shards = tuple(shards)
+        req = np.asarray(row_ids, dtype=np.int64)
+        canon = np.unique(req)
+        sel = np.searchsorted(canon, req)
+        cache: Dict = {}
+        if len(canon):
+            planes = np.stack([
+                self._host_plane(
+                    index, Leaf(field, VIEW_STANDARD, int(r)), shards, cache)
+                for r in canon
+            ])  # (R, S, W)
+        else:
+            planes = np.zeros((0, len(shards), WORDS_PER_ROW), np.uint32)
+        row_counts = None
+        if need_row_counts:
+            row_counts = _pop_elems(planes).sum(axis=2, dtype=np.int64)
+        inter = src_counts = None
+        if src_call is not None:
+            src = self._host_eval(index, src_call, shards, cache)  # (S, W)
+            src_counts = _pop_elems(src).sum(axis=1, dtype=np.int64)
+            masked = np.bitwise_and(planes, src[None, :, :])
+            inter = _pop_elems(masked).sum(axis=2, dtype=np.int64)
+        with self._lock:
+            self.counters["host_topn"] += 1
+        return (
+            row_counts[sel] if row_counts is not None else None,
+            inter[sel] if inter is not None else None,
+            src_counts,
+        )
+
+    def _cold_host_candidate(self, index: str, call: Call, comp: "_Compiler",
+                             shards: Tuple[int, ...]) -> bool:
+        """True when this Count should be answered compressed-domain: the
+        tree is host-expressible, every leaf is demoted (none resident in
+        HBM, all present in the tier), and this exact leaf set has not
+        been host-answered before — the second touch promotes normally so
+        hot planes climb back into HBM instead of re-decoding forever."""
+        if not self._cold_host or self.tier is None or not comp.leaves:
+            return False
+        if not self.host_supports(call):
+            return False
+        keys = [(index, leaf, shards) for leaf in comp.leaves]
+        kset = (index, tuple(comp.leaves), shards)
+        with self._lock:
+            if kset in self._cold_seen:
+                return False
+            if any(k in self._leaf_cache for k in keys):
+                return False
+        if not all(self.tier.has(k) for k in keys):
+            return False
+        with self._lock:
+            if len(self._cold_seen) >= 4096:
+                self._cold_seen.clear()
+            self._cold_seen.add(kset)
+        return True
+
     # -------------------------------------------------------------- queries
 
     def _compile(self, index: str, call: Call, field_cache: Optional[Dict] = None):
@@ -1043,7 +1474,19 @@ class ShardedQueryEngine:
         hit, token = self.memo_probe(index, comp, shards)
         if hit is not None:
             return hit
-        sig = ("count", tuple(comp.signature), len(shards))
+        if self._cold_host_candidate(index, call, comp, shards):
+            # Compressed-domain cold path: every leaf is demoted and this
+            # leaf set is a first touch — one numpy popcount over the
+            # host-tier bytes beats decode + device_put for a plane
+            # nobody re-reads. A repeat promotes normally.
+            plane = self._host_eval(index, call, shards, {})
+            result = int(_pop_elems(plane).sum())
+            with self._lock:
+                self.counters["host_cold_counts"] += 1
+            self.memo_store(token, result)
+            return result
+        hsig = tuple(comp.signature)
+        sig = ("count", hsig, len(shards))
 
         def build():
             @jax.jit
@@ -1055,10 +1498,10 @@ class ShardedQueryEngine:
 
             return fn
 
-        fn = self._fn_build(self._count_fns, sig, build)
+        fn = self._fn_build(self._count_fns, sig, build, health_sig=hsig)
         leaves = self._leaf_tensor(index, comp.leaves, shards)
         self._count_dispatch()
-        result = int(fn(leaves))
+        result = int(self._device_call(hsig, lambda: int(fn(leaves))))
         self.memo_store(token, result)
         return result
 
@@ -1071,7 +1514,8 @@ class ShardedQueryEngine:
         second AST walk."""
         shards = tuple(shards)
         comp, expr = comp_expr if comp_expr is not None else self._compile(index, call)
-        sig = ("count", tuple(comp.signature), len(shards))
+        hsig = tuple(comp.signature)
+        sig = ("count", hsig, len(shards))
 
         def build():
             @jax.jit
@@ -1081,10 +1525,10 @@ class ShardedQueryEngine:
 
             return fn
 
-        fn = self._fn_build(self._count_fns, sig, build)
+        fn = self._fn_build(self._count_fns, sig, build, health_sig=hsig)
         leaves = self._leaf_tensor(index, comp.leaves, shards)
         self._count_dispatch()
-        return fn(leaves)
+        return self._device_call(hsig, lambda: fn(leaves))
 
     def count_batch(self, index: str, calls: Sequence[Call], shards: Sequence[int],
                     comps=None) -> np.ndarray:
@@ -1112,12 +1556,35 @@ class ShardedQueryEngine:
             else:
                 out[i] = hit
         if miss:
-            res = np.asarray(
-                self.count_batch_async(
-                    index, [calls[i] for i in miss], shards,
-                    comps=[comps[i] for i in miss],
+            def run(sub):
+                arr = self.count_batch_async(
+                    index, [calls[i] for i in sub], shards,
+                    comps=[comps[i] for i in sub],
                 )
-            )[: len(miss)]
+                # Materialize INSIDE the guard: with jax's async dispatch
+                # a real device fault surfaces here, not at the enqueue
+                # the dispatch guard already wrapped — unguarded, it
+                # would escape as a raw XlaRuntimeError that bypasses
+                # classification, the breakers, and the ladder entirely.
+                # fire=False: the dispatch already paid the failpoint.
+                return self._device_call(
+                    tuple(comps[sub[0]][0].signature),
+                    lambda: np.asarray(arr)[: len(sub)], fire=False)
+
+            try:
+                res = run(miss)
+            except DeviceDispatchError as e:
+                # Reduced-batch retry: the full-size dispatch already got
+                # backpressure + one same-size retry inside _device_call;
+                # a batch that STILL OOMs re-dispatches as two halves
+                # (half the stacked working set each) before the error is
+                # allowed to reach a client.
+                if e.kind != OOM or len(miss) < 2:
+                    raise
+                with self._lock:
+                    self.counters["oom_batch_splits"] += 1
+                h = len(miss) // 2
+                res = np.concatenate([run(miss[:h]), run(miss[h:])])
             for j, i in enumerate(miss):
                 out[i] = int(res[j])
                 self.memo_store(tokens[i], int(res[j]))
@@ -1169,12 +1636,12 @@ class ShardedQueryEngine:
 
             return fn
 
-        fn = self._fn_build(self._count_fns, sig, build)
+        fn = self._fn_build(self._count_fns, sig, build, health_sig=sig0)
         leavess = tuple(
             self._leaf_tensor(index, comp.leaves, shards) for comp, _ in comps
         )
         self._count_dispatch()
-        return fn(leavess)
+        return self._device_call(sig0, lambda: fn(leavess))
 
     def _count_batch_setops(self, index: str, comps, shards: Tuple[int, ...],
                             q: int) -> jax.Array:
@@ -1288,11 +1755,12 @@ class ShardedQueryEngine:
                     return counts_of(stacked, idxs)
             return fn
 
-        fn = self._fn_build(self._count_fns, sig, build)
+        hsig = tuple(comps[0][0].signature)
+        fn = self._fn_build(self._count_fns, sig, build, health_sig=hsig)
         self._count_dispatch()
         if inv_in is not None:
-            return fn(stacked, idxs, inv_in)
-        return fn(stacked, idxs)
+            return self._device_call(hsig, lambda: fn(stacked, idxs, inv_in))
+        return self._device_call(hsig, lambda: fn(stacked, idxs))
 
     def _use_gather_kernel(self) -> bool:
         """Fused Pallas gather kernel on TPU (any mesh size: multi-device
@@ -1317,12 +1785,19 @@ class ShardedQueryEngine:
         segments stay on device (one (W,) plane per shard)."""
         shards = tuple(shards)
         comp, expr = comp_expr if comp_expr is not None else self._compile(index, call)
-        sig = ("bitmap", tuple(comp.signature), len(shards))
-        fn = self._fn_build(self._bitmap_fns, sig, lambda: jax.jit(expr))
+        hsig = tuple(comp.signature)
+        sig = ("bitmap", hsig, len(shards))
+        fn = self._fn_build(self._bitmap_fns, sig, lambda: jax.jit(expr),
+                            health_sig=hsig)
         leaves = self._leaf_tensor(index, comp.leaves, shards)
         with self._lock:
             self.counters["bitmap_dispatches"] += 1
-        planes = fn(leaves)  # (S_padded, W) sharded
+        # block_until_ready inside the guard: the Row keeps its segments
+        # on device (no host transfer), but forcing completion here makes
+        # an async device fault surface where it is classified and
+        # recorded instead of deep inside a later Row operation.
+        planes = self._device_call(
+            hsig, lambda: fn(leaves).block_until_ready())  # (S_padded, W)
         return Row({shard: planes[i] for i, shard in enumerate(shards)})
 
     def topn_shard_counts(
@@ -1417,7 +1892,9 @@ class ShardedQueryEngine:
                     return fn
 
                 fn = self._fn_build(self._count_fns, sig, build)
-                row_counts = np.asarray(fn(rows_tensor))[:r_real, :s_real]
+                row_counts = self._device_call(
+                    None,
+                    lambda: np.asarray(fn(rows_tensor))[:r_real, :s_real])
                 self._aux_store(rkey, rows_fp, row_counts)
 
         if src_call is not None:
@@ -1442,12 +1919,14 @@ class ShardedQueryEngine:
                 return fn
 
             fn = self._fn_build(self._count_fns, sig, build)
-            inter, src_counts = fn(rows_tensor, src_leaves)
-            value = (
-                row_counts,
-                np.asarray(inter)[:r_real, :s_real],
-                np.asarray(src_counts)[:s_real],
-            )
+
+            def run():
+                inter, src_counts = fn(rows_tensor, src_leaves)
+                return (np.asarray(inter)[:r_real, :s_real],
+                        np.asarray(src_counts)[:s_real])
+
+            inter, src_counts = self._device_call(None, run)
+            value = (row_counts, inter, src_counts)
         else:
             value = (row_counts, None, None)
         self._aux_store(mkey, fp, value)
@@ -1504,7 +1983,8 @@ class ShardedQueryEngine:
                 return fn
 
             fn = self._fn_build(self._count_fns, sig, build)
-            value = np.asarray(fn(rows_tensor, src_leaves))[:r_real]
+            value = self._device_call(
+                None, lambda: np.asarray(fn(rows_tensor, src_leaves))[:r_real])
             self._aux_store(mkey, fp, value)
             return value[sel]
 
@@ -1520,7 +2000,8 @@ class ShardedQueryEngine:
             return fn
 
         fn = self._fn_build(self._count_fns, sig, build)
-        value = np.asarray(fn(rows_tensor))[:r_real]
+        value = self._device_call(
+            None, lambda: np.asarray(fn(rows_tensor))[:r_real])
         self._aux_store(mkey, fp, value)
         return value[sel]
 
@@ -1603,12 +2084,17 @@ class ShardedQueryEngine:
             return fn
 
         fn = self._fn_build(self._count_fns, sig, build)
-        out = fn(planes, filter_leaves)
-        if kind == "sum":
-            value = np.asarray(out)
-        else:
+
+        def run():
+            # Materialization inside the guard (async-dispatch faults
+            # surface here, not at the enqueue).
+            out = fn(planes, filter_leaves)
+            if kind == "sum":
+                return np.asarray(out)
             bits, count = out
-            value = (np.asarray(bits), int(count))
+            return (np.asarray(bits), int(count))
+
+        value = self._device_call(None, run)
         self._aux_store(mkey, fp, value)
         return value
 

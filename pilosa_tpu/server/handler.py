@@ -634,6 +634,12 @@ class Handler:
             # other half).
             if engine.tier is not None:
                 out["tier"] = engine.tier.snapshot()
+            # Device-plane fault health (docs/fault-tolerance.md): breaker
+            # states, classified dispatch failures, and the host-ladder
+            # counters from engine_cache above — the on-call question
+            # during a device incident is "is the plane breaker open, and
+            # are queries being answered from the host ladder or erroring".
+            out["device_plane"] = engine.device_health.snapshot()
         # Scheduler lifecycle metrics: queue depth, admit/shed/deadline
         # counts, and the micro-batcher's launch/coalesce counters (wait
         # time and batch-size histograms live in the stats timings above).

@@ -478,6 +478,13 @@ class TierManager:
                 self.counters["delta_folds"] += folds
         return buf
 
+    def has(self, key) -> bool:
+        """True when `key`'s plane is held in the host or disk tier — the
+        engine's compressed-domain cold path (host_cold_counts) asks this
+        before deciding a Count can skip decode + device_put entirely."""
+        with self._lock:
+            return key in self._host or key in self._disk
+
     def note_hbm_hit(self, key) -> None:
         """Called by the engine on a leaf-cache probe hit: the first hit
         on a prefetched key is the prefetch paying off."""

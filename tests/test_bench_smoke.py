@@ -58,7 +58,7 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     assert not detail.get("partial"), detail.get("partial")
     assert parsed["value"] > 0
     stanzas = _registered_stanzas()
-    assert len(stanzas) >= 11  # the registry itself didn't shrink
+    assert len(stanzas) >= 15  # the registry itself didn't shrink
     for name in stanzas:
         stanza = detail.get(name)
         assert isinstance(stanza, dict), f"stanza {name} missing: {stanza}"
@@ -78,6 +78,17 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     fault = detail["fault"]
     assert fault["recovered"], fault
     assert fault["recovery_s"] < 30, fault
+    # The DEGRADE stanza is the device-fault acceptance metric: with
+    # every engine dispatch failing, the degraded phase must serve with
+    # ZERO query errors and bit-exact results (the host ladder), injected
+    # OOM must be absorbed (backpressure, no client error), and clearing
+    # the fault must re-close the plane breaker with queries proven back
+    # on the device path.
+    degrade = detail["degrade"]
+    assert degrade["device_fault"]["errors"] == 0, degrade
+    assert degrade["correct"], degrade
+    assert degrade["oom"]["errors"] == 0, degrade
+    assert degrade["recovered"], degrade
     # The TIER stanza is the tiered-storage acceptance metric: with the
     # working set ~3x the HBM budget, tiered eviction must beat
     # drop-and-regather on qps, with ZERO full regathers once the tiers

@@ -214,7 +214,7 @@ def test_network_failpoint_grammar():
         with pytest.raises(ValueError):
             failpoints.activate("client-send=flaky(nope)")
         with pytest.raises(ValueError):
-            failpoints.configure("x", "flaky", arg=1.5)
+            failpoints.configure("x", "flaky", arg=1.5)  # pilint: allow-failpoint(grammar test: validates rejection, never fires)
     finally:
         failpoints.reset()
 
@@ -242,7 +242,7 @@ def test_flaky_failpoint_is_seed_deterministic():
     def draws(seed):
         failpoints.reset()
         failpoints.seed(seed)
-        failpoints.configure("p", "flaky", arg=0.5)
+        failpoints.configure("p", "flaky", arg=0.5)  # pilint: allow-failpoint(registry test fires the point by hand below)
         out = []
         for _ in range(32):
             try:

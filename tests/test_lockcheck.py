@@ -329,11 +329,13 @@ def _run_instrumented(test_args, out_path, timeout, allow_test_failures=False):
 def test_instrumented_smoke_chaos_tier_rebalance(tmp_path):
     """Tier-1 enforcement: the concurrency-heavy test files (chaos fault
     injection, tier demote/promote/prefetch workers, live rebalance
-    migration streams) run fully instrumented and must produce zero
+    migration streams, and the device-fault ladder's host-execution +
+    breaker paths) run fully instrumented and must produce zero
     lock-order cycles and zero blocking-under-lock findings — the runtime
     half of the acceptance bar in docs/static-analysis.md."""
     payload = _run_instrumented(
-        ["tests/test_chaos.py", "tests/test_tier.py", "tests/test_rebalance.py"],
+        ["tests/test_chaos.py", "tests/test_tier.py",
+         "tests/test_rebalance.py", "tests/test_device_faults.py"],
         tmp_path / "lockcheck.json", timeout=600,
     )
     assert payload["count"] == 0, json.dumps(payload["findings"], indent=2)

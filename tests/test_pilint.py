@@ -485,6 +485,167 @@ class TestMutationEpochAudit:
         assert vs == []
 
 
+# ---------------------------------------------------------------- R6
+
+
+class TestFailpointHygiene:
+    def _env(self, docs=("wal-append",), fires=()):
+        env = RepoEnv()
+        env.failpoint_docs_loaded = True
+        env.failpoint_doc_names = set(docs)
+        env.failpoint_fire_sites = set(fires)
+        return env
+
+    def test_undocumented_fire_site_is_violation(self):
+        vs = lint("""
+            from . import failpoints
+
+            def append(self):
+                failpoints.fire("wal-apend")
+        """, env=self._env(), rules=["R6"])
+        assert codes(vs) == ["R6"]
+        assert "wal-apend" in vs[0].message
+
+    def test_documented_fire_site_is_fine(self):
+        vs = lint("""
+            from . import failpoints
+
+            def append(self):
+                failpoints.fire("wal-append")
+        """, env=self._env(), rules=["R6"])
+        assert vs == []
+
+    def test_targeted_fire_site_checks_base_name(self):
+        # fire() passes the target as a kwarg, so the literal IS the base
+        # name — a documented name with a target kwarg stays clean.
+        vs = lint("""
+            from . import failpoints
+
+            def send(self, netloc):
+                failpoints.fire("wal-append", target=netloc)
+        """, env=self._env(), rules=["R6"])
+        assert vs == []
+
+    def test_annotation_suppresses_fire_site(self):
+        vs = lint("""
+            from . import failpoints
+
+            def append(self):
+                # pilint: allow-failpoint(internal-only point, not for tests)
+                failpoints.fire("secret-point")
+        """, env=self._env(), rules=["R6"])
+        assert vs == []
+
+    def test_docs_not_loaded_no_ops(self):
+        # Fixture/snippet runs without the docs corpus must not flag.
+        env = RepoEnv()
+        vs = lint("""
+            from . import failpoints
+
+            def append(self):
+                failpoints.fire("whatever")
+        """, env=env, rules=["R6"])
+        assert vs == []
+
+    def test_outside_pilosa_tpu_not_checked(self):
+        vs = lint("""
+            def f():
+                fire("not-a-real-point")
+        """, path="bench.py", env=self._env(), rules=["R6"])
+        assert vs == []
+
+    def test_orphan_spec_in_test_is_violation(self):
+        from tools.pilint.rules import (collect_spec_sites,
+                                        failpoint_orphan_violations)
+
+        env = self._env(fires={"wal-append"})
+        env.failpoint_spec_sites = collect_spec_sites(
+            "tests/test_x.py", textwrap.dedent("""
+                import os
+                os.environ["PILOSA_TPU_FAILPOINTS"] = "wal-apend=error"
+            """))
+        vs = failpoint_orphan_violations(env)
+        assert codes(vs) == ["R6"]
+        assert "wal-apend" in vs[0].message
+
+    def test_spec_with_fire_site_is_fine(self):
+        from tools.pilint.rules import (collect_spec_sites,
+                                        failpoint_orphan_violations)
+
+        env = self._env(fires={"wal-append", "client-send"})
+        env.failpoint_spec_sites = collect_spec_sites(
+            "tests/test_x.py", textwrap.dedent("""
+                SPEC = "wal-append=1*crash;client-send@localhost:1=drop"
+                failpoints.configure("client-send", "latency", arg=5)
+            """))
+        assert failpoint_orphan_violations(env) == []
+
+    def test_configure_collected_and_target_stripped(self):
+        from tools.pilint.rules import collect_spec_sites
+
+        sites = collect_spec_sites(
+            "tests/test_x.py", textwrap.dedent("""
+                failpoints.configure("migrate-begin@host:1", "error")
+            """))
+        assert [n for _, _, n in sites] == ["migrate-begin"]
+
+    def test_allow_failpoint_annotation_excludes_spec(self):
+        from tools.pilint.rules import collect_spec_sites
+
+        sites = collect_spec_sites(
+            "tests/test_x.py", textwrap.dedent("""
+                failpoints.configure("p", "error")  # pilint: allow-failpoint(registry grammar test)
+            """))
+        assert sites == []
+
+    def test_plain_assignment_string_not_a_spec(self):
+        # Ordinary key=value literals must not parse as activation specs.
+        from tools.pilint.rules import collect_spec_sites
+
+        sites = collect_spec_sites(
+            "tests/test_x.py", 'H = "content-type=application/json"\n')
+        assert sites == []
+
+    def test_docs_table_parser_reads_section_rows(self):
+        from tools.pilint.rules import parse_failpoint_docs
+
+        names = parse_failpoint_docs(textwrap.dedent("""
+            ## Something else
+
+            | `not-a-point` | x |
+
+            ## Failpoints (`pilosa_tpu/failpoints.py`)
+
+            | failpoint | fires at |
+            |---|---|
+            | `wal-append` | WAL append |
+            | `device-dispatch` | engine dispatch |
+
+            ## After
+
+            | `also-not` | y |
+        """))
+        assert names == {"wal-append", "device-dispatch"}
+
+    def test_real_tree_docs_cover_every_fire_site(self):
+        """Belt and braces over the zero-violations test: the shipped
+        docs table and the shipped fire sites agree exactly on names."""
+        from tools.pilint.rules import (collect_fire_names,
+                                        parse_failpoint_docs)
+        import ast, glob
+
+        with open(os.path.join(REPO_ROOT, "docs", "durability.md")) as f:
+            doc_names = parse_failpoint_docs(f.read())
+        fired = set()
+        for path in glob.glob(
+                os.path.join(REPO_ROOT, "pilosa_tpu", "**", "*.py"),
+                recursive=True):
+            with open(path) as f:
+                fired |= collect_fire_names(ast.parse(f.read()))
+        assert fired, "no fire sites found — collection broke"
+        assert fired <= doc_names, fired - doc_names
+
+
 # ------------------------------------------------------- annotation grammar
 
 

@@ -21,7 +21,10 @@ from typing import Dict, List, Optional, Tuple
 # One kind per rule that supports suppression. R2 (jax-free zones) has no
 # escape hatch on purpose: a jax import in a config module is never
 # acceptable — move the import into the function that needs it.
-KNOWN_KINDS = ("swallow", "blocking", "counter", "mutation")
+# "failpoint" is shared by both halves of R6: on a fire() site it excuses
+# a name kept out of the docs table, and in a TEST file it marks a
+# deliberately-bogus spec (registry/grammar tests) as not-a-typo.
+KNOWN_KINDS = ("swallow", "blocking", "counter", "mutation", "failpoint")
 
 _ANNOT_RE = re.compile(
     r"#\s*pilint:\s*allow-(?P<kind>[a-z][a-z-]*)\((?P<reason>[^)]*)\)"

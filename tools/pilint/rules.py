@@ -12,7 +12,8 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
-from .core import FileContext, Violation, dotted_name, terminal_name
+from .core import (FileContext, Violation, dotted_name, parse_annotations,
+                   terminal_name)
 
 # --------------------------------------------------------------------------
 # cross-file environment
@@ -28,13 +29,30 @@ class RepoEnv:
     stats_wholesale: True when handler.py dumps `stats.snapshot()`
         wholesale into /debug/vars, which makes every `stats.count(name)`
         counter observable without listing its name.
+    failpoint_doc_names: failpoint names listed in docs/durability.md's
+        reference table (R6: every fire() site must appear there).
+    failpoint_docs_loaded: True when the docs file was actually read —
+        R6's fire-site half no-ops otherwise, so fixture runs that lint
+        a lone snippet without wiring the docs don't false-positive.
+    failpoint_fire_sites: every name passed to failpoints.fire() across
+        pilosa_tpu/ (R6: a test activation spec must name one of these —
+        a typo'd spec silently turns a fault test into a no-op).
+    failpoint_spec_sites: (path, line, name) of every failpoint name a
+        test activates/configures, with allow-failpoint-annotated lines
+        already filtered out.
     """
 
     wired_literals: Set[str] = field(default_factory=set)
     stats_wholesale: bool = False
+    failpoint_doc_names: Set[str] = field(default_factory=set)
+    failpoint_docs_loaded: bool = False
+    failpoint_fire_sites: Set[str] = field(default_factory=set)
+    failpoint_spec_sites: List = field(default_factory=list)
 
 
 WIRING_FILES = ("pilosa_tpu/server/handler.py", "pilosa_tpu/diagnostics.py")
+# R6's reference table lives in the durability doc (the failpoint section).
+FAILPOINT_DOC = "docs/durability.md"
 
 
 def build_env(sources: Dict[str, str]) -> RepoEnv:
@@ -400,6 +418,126 @@ def rule_counter_hygiene(ctx: FileContext, env: RepoEnv) -> List[Violation]:
 
 
 # --------------------------------------------------------------------------
+# R6: failpoint hygiene
+
+
+# Must track pilosa_tpu/failpoints.py's _SPEC_RE action set: a string is
+# only treated as an activation spec when its right-hand side parses as a
+# real action, so ordinary "key=value" literals never false-positive.
+_FP_NAME = r"[a-z][a-z0-9_.-]*"
+_FP_SPEC_PART_RE = re.compile(
+    rf"^(?P<name>{_FP_NAME})(?:@[^=;\s]+)?="
+    r"(?:\d+\*)?(?:error|crash|drop|oom|latency|flaky)(?:\([^)]*\))?$"
+)
+_FP_NAME_RE = re.compile(rf"^{_FP_NAME}(?:@.+)?$")
+
+
+def parse_failpoint_docs(text: str) -> Set[str]:
+    """Failpoint names from the reference table in docs/durability.md:
+    table rows (lines starting with `|`) inside the `## Failpoints`
+    section whose first cell is a backticked name."""
+    names: Set[str] = set()
+    in_section = False
+    for line in text.splitlines():
+        if line.startswith("## "):
+            in_section = "failpoint" in line.lower()
+            continue
+        if in_section:
+            m = re.match(rf"\|\s*`({_FP_NAME})`", line)
+            if m:
+                names.add(m.group(1))
+    return names
+
+
+def collect_fire_names(tree: ast.AST) -> Set[str]:
+    """Every string literal passed as the first arg of a fire() call."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and terminal_name(node.func) == "fire" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.add(node.args[0].value)
+    return out
+
+
+def collect_spec_sites(path: str, source: str) -> List:
+    """(path, line, base-name) for every failpoint a test activates:
+    string literals that parse as `name[@target]=action` specs (activate()
+    / PILOSA_TPU_FAILPOINTS values) plus plain-string first args of
+    configure(). Lines carrying `# pilint: allow-failpoint(reason)` are
+    excluded — registry/grammar tests use deliberately-bogus names."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    annotations, _ = parse_annotations(path, source)
+    ctx = FileContext(path=path, source=source, tree=tree,
+                      annotations=annotations)
+    out: List = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            for part in node.value.split(";"):
+                m = _FP_SPEC_PART_RE.match(part.strip())
+                if m and not ctx.allowed(node.lineno, "failpoint"):
+                    out.append((path, node.lineno, m.group("name")))
+        if (isinstance(node, ast.Call)
+                and terminal_name(node.func) == "configure" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            name = node.args[0].value
+            if (_FP_NAME_RE.match(name)
+                    and not ctx.allowed(node.lineno, "failpoint")):
+                out.append((path, node.lineno, name.split("@")[0]))
+    return out
+
+
+def rule_failpoint_hygiene(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    """R6a: every fire("<name>") site in pilosa_tpu/ must appear in the
+    docs/durability.md reference table — the table is how tests and
+    operators discover injection points, and an undocumented point is
+    one nobody will ever activate."""
+    if not ctx.path.startswith("pilosa_tpu/") or not env.failpoint_docs_loaded:
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and terminal_name(node.func) == "fire" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        name = node.args[0].value
+        if name in env.failpoint_doc_names:
+            continue
+        if ctx.allowed(node.lineno, "failpoint"):
+            continue
+        out.append(Violation(
+            ctx.path, node.lineno, "R6", "failpoint-hygiene",
+            f"failpoint {name!r} fires here but is missing from the "
+            f"reference table in {FAILPOINT_DOC} — add a table row or "
+            "annotate `# pilint: allow-failpoint(reason)`",
+        ))
+    return out
+
+
+def failpoint_orphan_violations(env: RepoEnv) -> List[Violation]:
+    """R6b (repo-level, emitted by the runner after per-file rules): every
+    failpoint name a test activates must have a fire() site — a typo'd
+    spec never fires, silently turning a fault test into a no-op."""
+    out: List[Violation] = []
+    for path, line, name in env.failpoint_spec_sites:
+        if name not in env.failpoint_fire_sites:
+            out.append(Violation(
+                path, line, "R6", "failpoint-hygiene",
+                f"activation spec names failpoint {name!r} but no "
+                "failpoints.fire() site carries that name — the spec "
+                "never fires and this fault test is a no-op; fix the "
+                "name or annotate `# pilint: allow-failpoint(reason)`",
+            ))
+    return out
+
+
+# --------------------------------------------------------------------------
 # R5: mutation-epoch audit (core/ only)
 
 
@@ -480,4 +618,5 @@ ALL_RULES = (
     ("R3", rule_blocking_under_lock),
     ("R4", rule_counter_hygiene),
     ("R5", rule_mutation_epoch),
+    ("R6", rule_failpoint_hygiene),
 )

@@ -8,7 +8,9 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from .core import (FileContext, Violation, parse_annotations,
                    unused_annotation_violations)
-from .rules import ALL_RULES, RepoEnv, WIRING_FILES, build_env
+from .rules import (ALL_RULES, FAILPOINT_DOC, RepoEnv, WIRING_FILES,
+                    build_env, collect_fire_names, collect_spec_sites,
+                    failpoint_orphan_violations, parse_failpoint_docs)
 
 _SKIP_PARTS = {"__pycache__", ".git"}
 
@@ -67,6 +69,37 @@ def lint_source(rel_path: str, source: str, env: RepoEnv,
     return sorted(violations, key=Violation.sort_key)
 
 
+def _load_failpoint_env(env: RepoEnv, root: str) -> None:
+    """R6's cross-file corpus, gathered independently of the lint target
+    set so `pilint pilosa_tpu/` still validates test specs: the docs
+    reference table, every fire() site under pilosa_tpu/, and every
+    activation spec under tests/."""
+    import ast as _ast
+
+    doc = os.path.join(root, FAILPOINT_DOC)
+    if os.path.exists(doc):
+        with open(doc, "r", encoding="utf-8") as f:
+            env.failpoint_doc_names = parse_failpoint_docs(f.read())
+        env.failpoint_docs_loaded = True
+    for f in _discover([os.path.join(root, "pilosa_tpu")]):
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                env.failpoint_fire_sites |= collect_fire_names(
+                    _ast.parse(fh.read()))
+        except (OSError, SyntaxError):
+            continue  # unreadable/unparseable files get their own E0
+    tests_dir = os.path.join(root, "tests")
+    if os.path.isdir(tests_dir):
+        for f in _discover([tests_dir]):
+            try:
+                with open(f, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+            except OSError:
+                continue
+            env.failpoint_spec_sites.extend(
+                collect_spec_sites(_relpath(f, root), src))
+
+
 def lint_paths(paths: Iterable[str], repo_root: Optional[str] = None,
                rules: Optional[Iterable[str]] = None) -> List[Violation]:
     """Lint every .py file under `paths`. repo_root anchors the relative
@@ -80,9 +113,14 @@ def lint_paths(paths: Iterable[str], repo_root: Optional[str] = None,
             with open(full, "r", encoding="utf-8") as f:
                 sources[rel] = f.read()
     env = build_env(sources)
+    selected = set(rules) if rules else None
+    if selected is None or "R6" in selected:
+        _load_failpoint_env(env, root)
     out: List[Violation] = []
     for f in files:
         out.extend(lint_file(f, env, repo_root=root, rules=rules))
+    if selected is None or "R6" in selected:
+        out.extend(failpoint_orphan_violations(env))
     return sorted(out, key=Violation.sort_key)
 
 
