@@ -132,6 +132,15 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    help="seconds a follower stays RESIZING before probing "
                         "the coordinator and reverting to NORMAL (legacy "
                         "resize watchdog)")
+    p.add_argument("--obs-sample-rate", dest="obs_sample_rate", type=float,
+                   help="fraction of queries traced end-to-end (0 disables "
+                        "local sampling; 1 traces every query)")
+    p.add_argument("--obs-ring-size", dest="obs_ring_size", type=int,
+                   help="completed traces retained for GET /debug/traces")
+    p.add_argument("--obs-slow-query-ms", dest="obs_slow_query_ms",
+                   type=float,
+                   help="log queries slower than this with their full "
+                        "stage breakdown (0 disables the slow-query log)")
     p.add_argument("--sched-max-queue", dest="sched_max_queue", type=int,
                    help="bounded admission queue; full requests get 429")
     p.add_argument("--sched-interactive-concurrency",

@@ -94,6 +94,10 @@ from .cluster.health import ResilienceConfig  # noqa: E402
 # rebalance machinery (cluster/rebalance.py). See docs/rebalance.md.
 from .cluster.rebalance import RebalanceConfig  # noqa: E402
 
+# And for [obs]: the per-query tracing knobs live with the trace recorder
+# (pilosa_tpu/obs/, jax-free). See docs/observability.md.
+from .obs import ObsConfig  # noqa: E402
+
 
 @dataclass
 class MetricConfig:
@@ -138,6 +142,7 @@ class Config:
     tier: TierConfig = field(default_factory=TierConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     rebalance: RebalanceConfig = field(default_factory=RebalanceConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     translation: TranslationConfig = field(default_factory=TranslationConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
@@ -220,6 +225,11 @@ class Config:
             "cutover-pause-max", self.rebalance.cutover_pause_max)
         self.rebalance.follower_timeout = rb.get(
             "follower-timeout", self.rebalance.follower_timeout)
+        ob = d.get("obs", {})
+        self.obs.sample_rate = ob.get("sample-rate", self.obs.sample_rate)
+        self.obs.ring_size = ob.get("ring-size", self.obs.ring_size)
+        self.obs.slow_query_ms = ob.get(
+            "slow-query-ms", self.obs.slow_query_ms)
         s = d.get("scheduler", {})
         self.scheduler.max_queue = s.get("max-queue", self.scheduler.max_queue)
         self.scheduler.interactive_concurrency = s.get(
@@ -365,6 +375,14 @@ class Config:
             if v is not None:
                 setattr(self.rebalance, attr, v)
         for attr, name, cast in [
+            ("sample_rate", "OBS_SAMPLE_RATE", float),
+            ("ring_size", "OBS_RING_SIZE", int),
+            ("slow_query_ms", "OBS_SLOW_QUERY_MS", float),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.obs, attr, v)
+        for attr, name, cast in [
             ("max_queue", "SCHED_MAX_QUEUE", int),
             ("interactive_concurrency", "SCHED_INTERACTIVE_CONCURRENCY", int),
             ("batch_concurrency", "SCHED_BATCH_CONCURRENCY", int),
@@ -478,6 +496,9 @@ class Config:
             "rebalance_cutover_pause_max":
                 ("rebalance", "cutover_pause_max"),
             "rebalance_follower_timeout": ("rebalance", "follower_timeout"),
+            "obs_sample_rate": ("obs", "sample_rate"),
+            "obs_ring_size": ("obs", "ring_size"),
+            "obs_slow_query_ms": ("obs", "slow_query_ms"),
             "sched_max_queue": ("scheduler", "max_queue"),
             "sched_interactive_concurrency": ("scheduler", "interactive_concurrency"),
             "sched_batch_concurrency": ("scheduler", "batch_concurrency"),
@@ -581,6 +602,11 @@ class Config:
             f"cutover-pause-max = {self.rebalance.cutover_pause_max}",
             f"follower-timeout = {self.rebalance.follower_timeout}",
             "",
+            "[obs]",
+            f"sample-rate = {self.obs.sample_rate}",
+            f"ring-size = {self.obs.ring_size}",
+            f"slow-query-ms = {self.obs.slow_query_ms}",
+            "",
             "[scheduler]",
             f"max-queue = {self.scheduler.max_queue}",
             f"interactive-concurrency = {self.scheduler.interactive_concurrency}",
@@ -678,6 +704,7 @@ class Config:
             tier_config=self.tier.validate(),
             resilience_config=self.resilience.validate(),
             rebalance_config=self.rebalance.validate(),
+            obs_config=self.obs.validate(),
         )
         kw.update(overrides)
         return Server(**kw)

@@ -148,6 +148,19 @@ class DiagnosticsCollector:
         api = getattr(self.server, "api", None)
         if api is not None:
             info["ingestImportBatches"] = getattr(api, "import_batches", 0)
+        # Per-query tracing shape (docs/observability.md): how many
+        # queries were traced, and how many crossed the slow-query
+        # threshold — the aggregate next to /debug/traces' per-trace
+        # detail.
+        recorder = getattr(self.server, "trace_recorder", None)
+        if recorder is not None:
+            snap = recorder.snapshot()
+            # traces_started counts the LOCAL sampler's hits; finished
+            # also counts adopted (coordinator-sampled) traces and would
+            # overstate sampling activity on a rate-0 follower.
+            info["obsTracesSampled"] = snap.get("traces_started", 0)
+            info["obsTracesAdopted"] = snap.get("traces_adopted", 0)
+            info["obsSlowQueries"] = snap.get("slow_queries", 0)
         # Peer fault-tolerance shape: how often breakers tripped, whether
         # replica retries ran into the budget, and how much traffic was
         # hedged — the aggregate story of how rough this node's network

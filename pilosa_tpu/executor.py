@@ -9,6 +9,7 @@ dispatch, the shard map/reduce (executor.go:1464-1593), two-phase TopN
 
 from __future__ import annotations
 
+import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
@@ -26,6 +27,7 @@ from .errors import (
     QueryError,
     TooManyWritesError,
 )
+from .obs import NOP_SPAN, current as obs_current, span as obs_span
 from .parallel.device_health import DeviceDispatchError
 from .pql import parser as pql_parser
 from .pql.ast import BETWEEN, Call, Condition, GT, GTE, LT, LTE, NEQ
@@ -225,7 +227,8 @@ class Executor:
         if idx is None:
             raise IndexNotFoundError(index)
         if isinstance(query, str):
-            query = pql_parser.parse(query)
+            with obs_span("parse"):
+                query = pql_parser.parse(query)
         if self.max_writes_per_request > 0 and len(query.write_calls()) > self.max_writes_per_request:
             raise TooManyWritesError(
                 f"too many writes: {len(query.write_calls())} > {self.max_writes_per_request}"
@@ -448,6 +451,21 @@ class Executor:
                     )
             return v
 
+        trace = obs_current()
+        reduce_acc = [0.0]
+        if trace is not None:
+            # One "reduce" span per fan-out (accumulated merge cost), not
+            # one span per reduce_fn call — merges interleave with
+            # gathers and per-merge spans would be noise.
+            t_fanout = _time.monotonic()
+            inner_reduce = reduce_fn
+
+            def reduce_fn(a, b, _f=inner_reduce):
+                t0 = _time.monotonic()
+                r = _f(a, b)
+                reduce_acc[0] += _time.monotonic() - t0
+                return r
+
         result = None
         failed: set = set()
         app_error = None
@@ -567,6 +585,11 @@ class Executor:
                     pending.extend(node_shards)
                     continue
                 result = v if result is None else reduce_fn(result, v)
+        if trace is not None:
+            trace.record(
+                "executor.fanout",
+                (_time.monotonic() - t_fanout) * 1000.0, shards=len(shards))
+            trace.record("reduce", reduce_acc[0] * 1000.0)
         return result
 
     def _remote_dispatch(self, node, index: str, c: Call, node_shards, kw):
@@ -576,9 +599,12 @@ class Executor:
         p99 or the configured fixed delay), the same shard batch is fired
         at a replica that also owns every shard in it, and the first good
         response wins. Hedge volume is capped by the health registry."""
-        import time as _time
-
         health = self.health
+        # Captured HERE (the request thread): hedge legs run on pool
+        # threads where the obs contextvar is not set, so the trace
+        # object travels by closure and each leg records its own
+        # remote:<peer> span (two legs = two spans, honestly).
+        trace = obs_current()
 
         def call(target):
             """One request with health accounting — success AND transport
@@ -586,15 +612,19 @@ class Executor:
             losing hedge leg (or an abandoned primary) still drives its
             peer's breaker even when its exception is never re-raised."""
             t0 = _time.monotonic()
-            try:
-                res = self.client.query_node(
-                    target, index, str(c), shards=node_shards, remote=True,
-                    **kw,
-                )[0]
-            except ClientError as e:
-                if _is_node_failure(e):
-                    health.record_failure(target.id)
-                raise
+            sp = (trace.span(f"remote:{target.id}", shards=len(node_shards))
+                  if trace is not None else NOP_SPAN)
+            call_kw = kw if trace is None else {**kw, "trace": sp}
+            with sp:
+                try:
+                    res = self.client.query_node(
+                        target, index, str(c), shards=node_shards,
+                        remote=True, **call_kw,
+                    )[0]
+                except ClientError as e:
+                    if _is_node_failure(e):
+                        health.record_failure(target.id)
+                    raise
             health.record_success(target.id, _time.monotonic() - t0)
             return res
 
@@ -863,6 +893,13 @@ class Executor:
             # the fused path; everything else stays on the device. The
             # half-open probe re-admits it via plan() after backoff.
             self._count_stat("DeviceSigQuarantined")
+            inner_map = map_fn
+
+            def map_fn(shard):
+                # The trace must show WHICH rung served a degraded query.
+                with obs_span("device.dispatch", rung="shard", shard=shard):
+                    return inner_map(shard)
+
             return self._map_reduce(index, shards, c, opt, map_fn, reduce_fn)
         if route == "host":
             # Plane breaker open: the device is sick — no dispatches at
@@ -875,8 +912,10 @@ class Executor:
                 def host_runner(local_shards):
                     if opt.deadline is not None:
                         opt.deadline.check("host execution")
-                    return self.engine.host_count(
-                        index, target, local_shards, comp_expr=compiled)
+                    with obs_span("device.dispatch", rung="host",
+                                  shards=len(local_shards)):
+                        return self.engine.host_count(
+                            index, target, local_shards, comp_expr=compiled)
 
                 return self._fan_out(
                     index, shards, c, opt, host_runner, reduce_fn)
@@ -886,12 +925,16 @@ class Executor:
             # One rung down for THIS batch: the breaker state decides
             # where the NEXT query routes; this query still answers.
             if kind == "count" and self.engine.host_supports(target):
-                return self.engine.host_count(
-                    index, target, local_shards, comp_expr=compiled)
+                with obs_span("device.dispatch", rung="host",
+                              shards=len(local_shards)):
+                    return self.engine.host_count(
+                        index, target, local_shards, comp_expr=compiled)
             result = None
-            for s in local_shards:
-                v = map_fn(s)
-                result = v if result is None else reduce_fn(result, v)
+            with obs_span("device.dispatch", rung="shard",
+                          shards=len(local_shards)):
+                for s in local_shards:
+                    v = map_fn(s)
+                    result = v if result is None else reduce_fn(result, v)
             return result
 
         def local_runner(local_shards):
@@ -900,15 +943,19 @@ class Executor:
                 # sits exactly at the engine-launch boundary.
                 opt.deadline.check("device dispatch")
             try:
-                if kind == "count":
-                    if self.batcher is not None:
-                        return self.batcher.count(
-                            index, target, local_shards, comp_expr=compiled,
-                            deadline=opt.deadline)
-                    return self.engine.count(
+                with obs_span("device.dispatch", rung="device",
+                              shards=len(local_shards)) as sp:
+                    if sp is not NOP_SPAN and health_sig is not None:
+                        sp.tag(sig=str(health_sig))
+                    if kind == "count":
+                        if self.batcher is not None:
+                            return self.batcher.count(
+                                index, target, local_shards,
+                                comp_expr=compiled, deadline=opt.deadline)
+                        return self.engine.count(
+                            index, target, local_shards, comp_expr=compiled)
+                    return self.engine.bitmap(
                         index, target, local_shards, comp_expr=compiled)
-                return self.engine.bitmap(
-                    index, target, local_shards, comp_expr=compiled)
             except DeviceDispatchError as e:
                 self._count_stat("DeviceLadderFallback")
                 self.logger.error(
@@ -972,10 +1019,12 @@ class Executor:
 
             def local_runner(local_shards):
                 try:
-                    out = self.engine.bsi_val_count(
-                        index, field_name, kind, depth, local_shards,
-                        filter_call
-                    )
+                    with obs_span("device.dispatch", rung="device",
+                                  shards=len(local_shards)):
+                        out = self.engine.bsi_val_count(
+                            index, field_name, kind, depth, local_shards,
+                            filter_call
+                        )
                 except DeviceDispatchError as e:
                     # Ladder rung for BSI: the bit-sliced scan is device
                     # code with no host twin, so the fallback is the
@@ -986,9 +1035,12 @@ class Executor:
                         "device BSI dispatch failed (%s), per-shard "
                         "fallback: %s", e.kind, e)
                     result = None
-                    for s in local_shards:
-                        v = map_fn(s)
-                        result = v if result is None else reduce_fn(result, v)
+                    with obs_span("device.dispatch", rung="shard",
+                                  shards=len(local_shards)):
+                        for s in local_shards:
+                            v = map_fn(s)
+                            result = (v if result is None
+                                      else reduce_fn(result, v))
                     return result
                 return self._compose_bsi_result(bsig, kind, out)
 
@@ -1079,9 +1131,11 @@ class Executor:
         host_ok = src_call is None or eng.host_supports(src_call)
         if eng.device_health.plan(None) == "device":
             try:
-                return eng.topn_shard_counts(
-                    index, field, ids, local_shards, src_call,
-                    need_row_counts=need_rc)
+                with obs_span("device.dispatch", rung="device",
+                              shards=len(local_shards)):
+                    return eng.topn_shard_counts(
+                        index, field, ids, local_shards, src_call,
+                        need_row_counts=need_rc)
             except DeviceDispatchError as e:
                 if not host_ok:
                     raise
@@ -1095,9 +1149,11 @@ class Executor:
                 "device plane degraded and TopN src is not host-executable")
         else:
             self._count_stat("DeviceHostRouted")
-        return eng.host_topn_shard_counts(
-            index, field, ids, local_shards, src_call,
-            need_row_counts=need_rc)
+        with obs_span("device.dispatch", rung="host",
+                      shards=len(local_shards)):
+            return eng.host_topn_shard_counts(
+                index, field, ids, local_shards, src_call,
+                need_row_counts=need_rc)
 
     def _execute_topn(self, index: str, c: Call, shards: List[int], opt: ExecOptions) -> List[Pair]:
         ids_arg = self._uint_slice_arg(c, "ids")
@@ -1425,8 +1481,6 @@ class Executor:
         retrying up to `cutover_wait` while the commit broadcast lands,
         so a write racing the cutover follows the shard to its new owner
         instead of failing. Past the cap it surfaces clean (retryable)."""
-        import time as _time
-
         from .errors import ShardMovedError
 
         deadline = _time.monotonic() + (0.0 if remote else

@@ -26,6 +26,7 @@ import numpy as np
 
 from .. import failpoints
 from ..constants import VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD, WORDS_PER_ROW
+from ..obs import NOP_SPAN, span as obs_span
 from ..core.row import Row
 from ..errors import FieldNotFoundError, BSIGroupNotFoundError, QueryError
 from ..ops import bitplane as bp
@@ -870,45 +871,55 @@ class ShardedQueryEngine:
         if arr is not None:
             return arr
         evicted: List = []
-        try:
-            # Stale resident entry: try the delta path first — upload only
-            # the words the writes changed instead of re-walking every
-            # shard's containers and re-shipping the whole plane.
-            with self._lock:
-                stale = self._leaf_cache.get(key)
-            if stale is not None:
-                arr = self._leaf_delta(key, leaf.row, stale, frags,
-                                       fingerprint, evicted)
-                if arr is not None:
-                    return arr
-            # Demoted plane? Decode the compressed host/disk-tier image
-            # (journal deltas folded) instead of walking every shard's
-            # live containers.
-            buf = None
-            if self.tier is not None:
-                buf = self.tier.promote(key, frags, fingerprint, s_padded)
-            tier_hit = buf is not None
-            if buf is None:
-                buf = self._host_gather(frags, leaf.row, s_padded)
-            arr = self._oom_guard(None, lambda: jax.device_put(
-                buf, shard_sharding(self.mesh, 2)))
-            with self._lock:
-                if tier_hit:
-                    self.counters["leaf_tier_hits"] += 1
-                    self.counters["tier_promote_bytes"] += buf.nbytes
-                else:
-                    self.counters["leaf_misses"] += 1
-                    self.counters["full_refresh_bytes"] += buf.nbytes
-                self._leaf_bytes = self._byte_cache_put(
-                    self._leaf_cache, key, (fingerprint, arr),
-                    self._leaf_budget, self._leaf_bytes, "leaf_evictions",
-                    evicted,
-                )
-        finally:
-            self._release(("leaf", key))
-            # Evicted planes demote off-lock whichever path installed the
-            # fresh entry (full gather, tier promote, or delta refresh).
-            self._demote_keys(evicted)
+        # The gather stage is where a slow query's time hides: the trace
+        # span tags WHICH refresh path ran (delta scatter vs compressed-
+        # tier promote vs cold container walk) so /debug/traces answers
+        # "why was this gather 30 ms" without correlating counters.
+        with obs_span("gather") as sp:
+            try:
+                # Stale resident entry: try the delta path first — upload
+                # only the words the writes changed instead of re-walking
+                # every shard's containers and re-shipping the whole plane.
+                with self._lock:
+                    stale = self._leaf_cache.get(key)
+                if stale is not None:
+                    arr = self._leaf_delta(key, leaf.row, stale, frags,
+                                           fingerprint, evicted)
+                    if arr is not None:
+                        sp.tag(kind="delta")
+                        return arr
+                # Demoted plane? Decode the compressed host/disk-tier image
+                # (journal deltas folded) instead of walking every shard's
+                # live containers.
+                buf = None
+                if self.tier is not None:
+                    buf = self.tier.promote(key, frags, fingerprint, s_padded)
+                tier_hit = buf is not None
+                if buf is None:
+                    buf = self._host_gather(frags, leaf.row, s_padded)
+                if sp is not NOP_SPAN:
+                    sp.tag(kind="tier-promote" if tier_hit else "cold",
+                           bytes=int(buf.nbytes))
+                arr = self._oom_guard(None, lambda: jax.device_put(
+                    buf, shard_sharding(self.mesh, 2)))
+                with self._lock:
+                    if tier_hit:
+                        self.counters["leaf_tier_hits"] += 1
+                        self.counters["tier_promote_bytes"] += buf.nbytes
+                    else:
+                        self.counters["leaf_misses"] += 1
+                        self.counters["full_refresh_bytes"] += buf.nbytes
+                    self._leaf_bytes = self._byte_cache_put(
+                        self._leaf_cache, key, (fingerprint, arr),
+                        self._leaf_budget, self._leaf_bytes, "leaf_evictions",
+                        evicted,
+                    )
+            finally:
+                self._release(("leaf", key))
+                # Evicted planes demote off-lock whichever path installed
+                # the fresh entry (full gather, tier promote, or delta
+                # refresh).
+                self._demote_keys(evicted)
         return arr
 
     # ------------------------------------------------------- cold gather
@@ -1162,7 +1173,11 @@ class ShardedQueryEngine:
             with self._lock:
                 stale = self._stack_cache.get(key)
             if stale is not None:
-                stacked = self._stack_delta(key, index, leaves, shards, stale, fp)
+                with obs_span("gather", kind="stack-delta") as sp:
+                    stacked = self._stack_delta(
+                        key, index, leaves, shards, stale, fp)
+                    if sp is not NOP_SPAN:
+                        sp.tag(applied=stacked is not None)
                 if stacked is not None:
                     return stacked
             # Stale or missing: gather member planes (leaf-cache hits are

@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..obs import record as obs_record, span as obs_span
 from .deadline import Deadline
 
 
@@ -121,6 +122,10 @@ class MicroBatcher:
         engine = self.get_engine()
         window = self.effective_window()
         if window <= 0:
+            # Zero-duration hold recorded so a trace still shows the
+            # micro-batcher stage (held=0 means "nobody to coalesce
+            # with, dispatched immediately").
+            obs_record("batch.hold", 0.0, held=0)
             return engine.count(index, call, shards, comp_expr=comp_expr)
         if comp_expr is None or comp_expr is True:
             comp_expr = engine._compile(index, call)
@@ -155,7 +160,8 @@ class MicroBatcher:
                     del self._pending[key]
                 group.full.set()
         if leader:
-            self.wait_window(group, window)
+            with obs_span("batch.hold", role="leader", held=1):
+                self.wait_window(group, window)
             self._run(key, group, engine, index, shards)
         else:
             # Leader wedged (device hang) or deadline pressure: fall back
@@ -165,7 +171,10 @@ class MicroBatcher:
             budget = 30.0
             if deadline is not None:
                 budget = max(0.0, min(budget, deadline.remaining()))
-            if not item.event.wait(timeout=budget + 10 * self.window_max):
+            with obs_span("batch.hold", role="follower", held=1):
+                answered = item.event.wait(
+                    timeout=budget + 10 * self.window_max)
+            if not answered:
                 with self._lock:
                     self.counters["fallbacks"] += 1
                 if deadline is not None:

@@ -25,6 +25,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
 from ..errors import PilosaError
+from ..obs import record as obs_record
 from .deadline import Deadline, DeadlineExceededError
 
 CLASS_INTERACTIVE = "interactive"
@@ -195,6 +196,10 @@ class QueryScheduler:
                     self._waiting -= 1
                     self._waiting_by[cls] -= 1
         wait_ms = (self.clock() - start) * 1000.0
+        # Admission wait as a trace stage (docs/observability.md): a slow
+        # query that spent its time QUEUED shows it here, not as device
+        # time. No-op (contextvar miss) when the query isn't traced.
+        obs_record("sched.wait", wait_ms, cls=cls)
         with self._lock:
             self.counters["admitted"] += 1
             self.counters[f"admitted_{cls}"] += 1

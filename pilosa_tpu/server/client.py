@@ -162,7 +162,11 @@ class InternalClient:
     def _request(self, method: str, url: str, body: Optional[bytes] = None,
                  content_type: str = "application/json",
                  accept: Optional[str] = None,
-                 extra_headers: Optional[Dict[str, str]] = None) -> bytes:
+                 extra_headers: Optional[Dict[str, str]] = None,
+                 want_headers: bool = False):
+        """Returns the response body, or (body, lowercased-header-dict)
+        when want_headers — the tracing path reads the peer's
+        X-Pilosa-Trace-Summary off the response."""
         parts = urllib.parse.urlsplit(url)
         path = parts.path + (f"?{parts.query}" if parts.query else "")
         headers = {}
@@ -238,6 +242,8 @@ class InternalClient:
                 raise ClientError(
                     f"{method} {url}: {resp.status} {detail}", status=resp.status
                 )
+            if want_headers:
+                return data, {k.lower(): v for k, v in resp.getheaders()}
             return data
 
     # ---------------------------------------------------------------- query
@@ -245,14 +251,18 @@ class InternalClient:
     def query_node(self, node, index: str, query: str,
                    shards: Optional[Sequence[int]] = None, remote: bool = True,
                    deadline: Optional[float] = None,
-                   epoch: Optional[int] = None) -> List[Any]:
+                   epoch: Optional[int] = None, trace=None) -> List[Any]:
         """Execute PQL on a peer restricted to its shards (http/client.go
         QueryNode). `deadline` is the coordinator's REMAINING budget in
         seconds; it rides X-Pilosa-Deadline so the peer aborts its own
         device dispatches at the same cutoff. `epoch` is the sender's
         routing epoch (X-Pilosa-Epoch): a peer that has advanced past it
         and no longer serves the requested shards answers 409 instead of
-        a hole from a migrated/GC'd fragment."""
+        a hole from a migrated/GC'd fragment. `trace` is the caller's
+        remote-hop Span (obs.Span): the trace id rides X-Pilosa-Trace so
+        the peer records into the same cross-node tree, and the peer's
+        X-Pilosa-Trace-Summary response header is spliced back as the
+        hop's child spans."""
         from . import wire
 
         params = {"remote": "true"} if remote else {}
@@ -265,9 +275,16 @@ class InternalClient:
             extra["X-Pilosa-Deadline"] = f"{max(deadline, 0.0):.6f}"
         if epoch is not None:
             extra["X-Pilosa-Epoch"] = str(int(epoch))
+        if trace is not None:
+            extra["X-Pilosa-Trace"] = trace.wire_id()
         extra = extra or None
-        raw = self._request("POST", url, body, accept=wire.CONTENT_TYPE,
-                            extra_headers=extra)
+        raw, resp_headers = self._request(
+            "POST", url, body, accept=wire.CONTENT_TYPE,
+            extra_headers=extra, want_headers=True)
+        if trace is not None:
+            summary = resp_headers.get("x-pilosa-trace-summary")
+            if summary:
+                trace.splice(summary)
         # Binary data plane when the peer speaks it (packed bitplanes);
         # JSON fallback keeps mixed-version clusters working.
         if wire.is_wire(raw):

@@ -129,6 +129,8 @@ class Handler:
             Route("POST", r"/internal/index/(?P<index>[^/]+)/attr/diff", self.handle_index_attr_diff),
             Route("POST", r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/attr/diff", self.handle_field_attr_diff),
             Route("GET", r"/debug/vars", self.handle_debug_vars),
+            Route("GET", r"/debug/traces", self.handle_debug_traces),
+            Route("GET", r"/metrics", self.handle_metrics),
             Route("POST", r"/debug/profile", self.handle_debug_profile),
             Route("GET", r"/debug/threads", self.handle_debug_threads),
             Route("GET", r"/internal/diagnostics", self.handle_diagnostics),
@@ -381,6 +383,49 @@ class Handler:
         if "shards" in query:
             shards = [int(s) for s in query["shards"][0].split(",")]
 
+        # Per-query tracing (docs/observability.md): adopt the
+        # coordinator's trace id from X-Pilosa-Trace (stamped next to the
+        # deadline/epoch headers) so this node's spans splice into ONE
+        # cross-node tree, else roll the ingress sampler. Downstream
+        # stages record through the obs contextvar; the trace lands in
+        # the /debug/traces ring (and the slow-query log) at finish.
+        from .. import obs as _obs
+
+        recorder = getattr(self.api.server, "trace_recorder", None)
+        trace = None
+        if recorder is not None:
+            trace_hdr = headers.get("x-pilosa-trace")
+            if trace_hdr and remote:
+                # Adoption is for coordinator-forwarded sub-queries ONLY
+                # (remote=true): they bypass the local sampler because
+                # the coordinator already rolled it. An ordinary client
+                # stamping the header must not force tracing on a node
+                # whose operator set sample-rate 0 — the knob's whole
+                # point is bounding overhead and /debug/traces retention.
+                trace = recorder.adopt(trace_hdr, index=index, pql=pql)
+            elif not remote:
+                trace = recorder.maybe_start(index=index, pql=pql)
+        if trace is None:
+            return self._post_query_traced(
+                index, pql, shards, remote, column_attrs, exclude_row_attrs,
+                exclude_columns, deadline, epoch, wants_proto, headers,
+                None, None)
+        token = _obs.activate(trace)
+        try:
+            return self._post_query_traced(
+                index, pql, shards, remote, column_attrs, exclude_row_attrs,
+                exclude_columns, deadline, epoch, wants_proto, headers,
+                recorder, trace)
+        except BaseException:
+            recorder.finish(trace, status="error")
+            raise
+        finally:
+            _obs.deactivate(token)
+            recorder.finish(trace)
+
+    def _post_query_traced(self, index, pql, shards, remote, column_attrs,
+                           exclude_row_attrs, exclude_columns, deadline,
+                           epoch, wants_proto, headers, recorder, trace):
         if wants_proto:
             from . import proto
             from ..errors import PilosaError
@@ -409,11 +454,27 @@ class Handler:
                                      deadline=deadline, epoch=epoch)
             from . import wire
 
+            extra = {}
+            if trace is not None:
+                # The peer side of cross-node splicing: finish THIS node's
+                # trace now (all spans are complete — the query returned)
+                # and return its stage summary, size-bounded, so the
+                # coordinator attaches it as child spans of its
+                # remote:<peer> span. finish() is idempotent; the
+                # handler's finally only re-lands errors.
+                recorder.finish(trace)
+                from ..obs.trace import SUMMARY_MAX_BYTES
+
+                extra["X-Pilosa-Trace-Summary"] = trace.summary_header(
+                    SUMMARY_MAX_BYTES)
             if wire.CONTENT_TYPE in headers.get("accept", ""):
                 # Binary data plane: packed bitplanes instead of JSON column
                 # lists (a dense 1M-column Row is 128KiB, not ~10MB).
-                return 200, wire.CONTENT_TYPE, wire.encode_results(results)
-            return {"results": [serialize_remote(r) for r in results]}
+                return 200, wire.CONTENT_TYPE, wire.encode_results(results), extra
+            return (200, "application/json",
+                    json.dumps({"results": [serialize_remote(r)
+                                            for r in results]}).encode(),
+                    extra)
         return self.api.query_response(
             index, pql, shards=shards, column_attrs=column_attrs,
             exclude_row_attrs=exclude_row_attrs, exclude_columns=exclude_columns,
@@ -694,11 +755,49 @@ class Handler:
             rb["active"] = cluster.next_nodes is not None
             rb["migrated_shards"] = len(cluster.migrated)
             out["rebalance"] = rb
+        # Per-query tracing health (docs/observability.md): sampler
+        # counters, ring depth, slow-query count — the aggregate next to
+        # the per-trace detail /debug/traces serves.
+        recorder = getattr(self.api.server, "trace_recorder", None)
+        if recorder is not None:
+            out["obs"] = recorder.snapshot()
         from .. import failpoints as _fp
 
         if _fp.active():
             out["failpoints"] = _fp.active()
         return out
+
+    def handle_debug_traces(self, query, **kw):
+        """Completed per-query traces from the recorder's bounded ring,
+        newest first. Filters: ?min-ms= (minimum duration), ?index=,
+        ?limit= (default 64). Each trace is the FULL cross-node tree the
+        coordinator assembled (remote hops carry the peer's spliced child
+        spans)."""
+        recorder = getattr(self.api.server, "trace_recorder", None)
+        if recorder is None:
+            return {"traces": []}
+        try:
+            min_ms = float(query.get("min-ms", ["0"])[0])
+            limit = int(query.get("limit", ["64"])[0])
+        except ValueError as e:
+            # Malformed operator input is a 400, not a 500 traceback.
+            raise PilosaError(f"invalid /debug/traces parameter: {e}") from None
+        index = query.get("index", [None])[0]
+        return {"traces": recorder.traces(min_ms=min_ms, index=index,
+                                          limit=limit)}
+
+    def handle_metrics(self, **kw):
+        """Prometheus text exposition: the /debug/vars counter groups
+        (same dict — the two surfaces cannot disagree) plus the trace
+        recorder's per-stage latency histograms, so the node is
+        scrapeable without custom tooling."""
+        from ..obs import metrics as _metrics
+
+        out = self.handle_debug_vars()
+        recorder = getattr(self.api.server, "trace_recorder", None)
+        hists = recorder.stage_histograms() if recorder is not None else {}
+        text = _metrics.render_prometheus(out, hists)
+        return 200, _metrics.CONTENT_TYPE, text.encode()
 
     _profile_lock = threading.Lock()
 

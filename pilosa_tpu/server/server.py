@@ -65,6 +65,7 @@ class Server:
         ingest_config=None,
         engine_config=None,
         tier_config=None,
+        obs_config=None,
         join_addr: Optional[str] = None,
         allowed_origins: Optional[List[str]] = None,
         tls_certificate: Optional[str] = None,
@@ -226,6 +227,17 @@ class Server:
             stats=self.stats,
         )
         self.executor.batcher = self.batcher
+        # Per-query trace recorder (docs/observability.md): sampled stage
+        # spans through the whole serving path, /debug/traces ring,
+        # slow-query log, per-stage histograms for /metrics. The handler
+        # starts/adopts traces; everything downstream records via the
+        # obs contextvar.
+        from ..obs import ObsConfig, TraceRecorder
+
+        self.obs_config = (obs_config or ObsConfig()).validate()
+        self.trace_recorder = TraceRecorder(
+            self.obs_config, stats=self.stats, logger=self.logger,
+        )
         self.api = API(self)
         self.handler = Handler(
             self.api, logger=self.logger, allowed_origins=allowed_origins,

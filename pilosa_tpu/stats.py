@@ -11,8 +11,63 @@ from __future__ import annotations
 import json
 import threading
 import time
+from bisect import bisect_left
 from collections import defaultdict
 from typing import Dict, List, Optional
+
+
+class Histogram:
+    """Fixed log-bucketed histogram: count/sum/min/max plus counts per
+    power-of-2 upper bound. Replaces the old per-key append-forever
+    timing lists (a slow memory leak under sustained traffic, and
+    /debug/vars copied + serialized the whole list per scrape): memory is
+    O(buckets) however many observations land, snapshot() is what both
+    /debug/vars and the /metrics Prometheus exposition need, and callers
+    never pay more than one bisect per observation. Not self-locking —
+    owners (InMemoryStatsClient, TraceRecorder) observe under their own
+    lock, same as their counter dicts."""
+
+    # 0.0625 .. 16384 in powers of two; values are usually milliseconds
+    # (Timer) but the bounds work for any positive magnitude (batch
+    # sizes, queue depths). Everything above the top bound lands in +Inf.
+    BOUNDS = tuple(float(2.0 ** e) for e in range(-4, 15))
+
+    __slots__ = ("count", "sum", "min", "max", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        # Per-bucket (non-cumulative) counts; index len(BOUNDS) is +Inf.
+        self.buckets = [0] * (len(self.BOUNDS) + 1)
+
+    def observe(self, value) -> None:
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+        self.buckets[bisect_left(self.BOUNDS, v)] += 1
+
+    def snapshot(self) -> dict:
+        """JSON-friendly view: nonzero buckets keyed by upper bound
+        ("+Inf" for the overflow bucket). The /metrics renderer rebuilds
+        the cumulative `le` series from BOUNDS."""
+        buckets = {}
+        for i, n in enumerate(self.buckets):
+            if n:
+                key = "+Inf" if i == len(self.BOUNDS) else repr(self.BOUNDS[i])
+                buckets[key] = n
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "buckets": buckets,
+        }
 
 
 class NopStatsClient:
@@ -56,7 +111,9 @@ class InMemoryStatsClient:
         if _root is None:
             self.counters: Dict[str, float] = defaultdict(float)
             self.gauges: Dict[str, float] = {}
-            self.timings: Dict[str, List[float]] = defaultdict(list)
+            # Bounded log-bucketed histograms, NOT raw value lists: the
+            # old per-key append grew without limit under traffic.
+            self.timings: Dict[str, Histogram] = defaultdict(Histogram)
             self.sets: Dict[str, set] = defaultdict(set)
             self._lock = threading.Lock()
 
@@ -88,7 +145,7 @@ class InMemoryStatsClient:
     def histogram(self, name, value, rate=1.0):
         root = self._root
         with root._lock:
-            root.timings[self._key(name)].append(value)
+            root.timings[self._key(name)].observe(value)
 
     def set(self, name, value, rate=1.0):
         root = self._root
@@ -104,7 +161,7 @@ class InMemoryStatsClient:
             return {
                 "counters": dict(root.counters),
                 "gauges": dict(root.gauges),
-                "timings": {k: list(v) for k, v in root.timings.items()},
+                "timings": {k: v.snapshot() for k, v in root.timings.items()},
                 "sets": {k: sorted(map(str, v)) for k, v in root.sets.items()},
             }
 

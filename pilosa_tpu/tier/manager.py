@@ -45,6 +45,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..constants import WORDS_PER_ROW
+from ..obs import NOP_SPAN, span as obs_span
 from ..storage.bitmap import decode_plane_words
 from . import TierConfig
 
@@ -440,6 +441,15 @@ class TierManager:
                     self._spill(skey, sent)
         if ent is None or len(ent.fps) != len(frags):
             return None
+        # Traced from here (not the quick miss-probe above): the span
+        # measures the decode + journal-fold cost a promotion actually
+        # paid, which is the number a slow-query breakdown needs.
+        with obs_span("tier.promote", shards=len(frags)) as sp:
+            buf = self._decode_promoted(key, ent, frags, fingerprint,
+                                        s_padded, sp)
+        return buf
+
+    def _decode_promoted(self, key, ent, frags, fingerprint, s_padded, sp):
         index, leaf, shards = key
         buf = np.zeros((s_padded, WORDS_PER_ROW), dtype=np.uint32)
         walks = folds = 0
@@ -476,6 +486,8 @@ class TierManager:
             with self._lock:
                 self.counters["shard_walks"] += walks
                 self.counters["delta_folds"] += folds
+        if sp is not NOP_SPAN and (walks or folds):
+            sp.tag(walks=walks, folds=folds)
         return buf
 
     def has(self, key) -> bool:

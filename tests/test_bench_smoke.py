@@ -4,6 +4,11 @@ scale and emit a complete, parseable JSON line.
 Two measurement rounds were lost to rc=124 / `parsed: null` because bench
 breakage only surfaced at measurement time; this test makes a broken
 stanza (or a hung bring-up path) a PR-time failure instead.
+
+Timing-RATIO gates (TIER qps vs drop-and-regather, OBS traced-vs-untraced
+qps) can flake when the whole suite's load shares the box: a failed ratio
+gate reruns JUST that stanza once in isolation — with the retry recorded
+in the test output — before failing. Correctness gates never retry.
 """
 
 import importlib.util
@@ -23,11 +28,13 @@ def _registered_stanzas():
     spec = importlib.util.spec_from_file_location("_bench_mod", BENCH)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return tuple(name.lower() for name, _ in mod.STANZAS)
+    return tuple(name for name, _ in mod.STANZAS)
 
 
-def test_bench_smoke_runs_every_stanza(tmp_path):
-    out_path = tmp_path / "bench_out.json"
+def _run_bench(out_path, only=None):
+    """One BENCH_SMOKE subprocess; `only` reruns a single stanza in
+    isolation (every other stanza skipped via its BENCH_<NAME>=0 gate).
+    Returns the parsed detail dict of the final JSON line."""
     env = dict(os.environ)
     env.update(
         BENCH_SMOKE="1",
@@ -40,6 +47,10 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
         # watchdog emits a partial line well inside the pytest timeout.
         BENCH_DEADLINE="240",
     )
+    if only is not None:
+        for name in _registered_stanzas():
+            if name != only:
+                env[f"BENCH_{name}"] = "0"
     r = subprocess.run(
         [sys.executable, BENCH], env=env, capture_output=True, text=True,
         timeout=300,
@@ -53,14 +64,41 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
         if line.startswith("{"):
             last = line
     assert last is not None, f"no JSON line in stdout:\n{r.stdout[-2000:]}"
-    parsed = json.loads(last)
+    return json.loads(last)
+
+
+def _retry_ratio_gate(name, stanza, gate, tmp_path):
+    """Deflake for timing-RATIO gates: when `gate(stanza)` fails under
+    full-suite load, rerun the one stanza in isolation ONCE (recorded in
+    the test output) and judge the rerun. Known flake: the TIER
+    qps-ratio assert under box load."""
+    if gate(stanza):
+        return stanza
+    import warnings
+
+    # warnings.warn, not print: pytest swallows captured stdout on
+    # PASSING tests, and the whole point is that a chronically flaky
+    # gate leaves a visible record even when the rerun saves it.
+    warnings.warn(
+        f"{name} ratio gate failed under full-suite load; "
+        f"reran {name} alone once (first result: {stanza})")
+    parsed = _run_bench(tmp_path / f"bench_retry_{name.lower()}.json",
+                        only=name)
+    retried = parsed["detail"][name.lower()]
+    retried["retried_in_isolation"] = True
+    print(f"{name} isolation rerun result: {retried}")
+    return retried
+
+
+def test_bench_smoke_runs_every_stanza(tmp_path):
+    parsed = _run_bench(tmp_path / "bench_out.json")
     detail = parsed["detail"]
     assert not detail.get("partial"), detail.get("partial")
     assert parsed["value"] > 0
     stanzas = _registered_stanzas()
-    assert len(stanzas) >= 15  # the registry itself didn't shrink
+    assert len(stanzas) >= 16  # the registry itself didn't shrink
     for name in stanzas:
-        stanza = detail.get(name)
+        stanza = detail.get(name.lower())
         assert isinstance(stanza, dict), f"stanza {name} missing: {stanza}"
         assert "error" not in stanza, f"stanza {name}: {stanza['error']}"
     # The MIXED stanza is the delta-refresh acceptance metric: delta-on
@@ -94,11 +132,28 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     # drop-and-regather on qps, with ZERO full regathers once the tiers
     # are warm — including after writes that stay within the delta bound
     # (the journal folds on promotion instead of poisoning to a walk).
+    # The qps RATIO is a known box-load flake: it gets one isolation
+    # rerun; the regather counters are correctness gates and never retry.
     tier = detail["tier"]
-    assert tier["tiered"]["qps"] > tier["drop_regather"]["qps"], tier
     assert tier["tiered"]["full_regathers"] == 0, tier
     assert tier["tiered"]["post_write_full_regathers"] == 0, tier
     assert tier["prefetch"]["promotions"] > 0, tier
+    tier = _retry_ratio_gate(
+        "TIER", tier,
+        lambda t: t["tiered"]["qps"] > t["drop_regather"]["qps"], tmp_path)
+    assert tier["tiered"]["qps"] > tier["drop_regather"]["qps"], tier
+    # The OBS stanza is the tracing acceptance metric: sample-rate 1.0
+    # must hold qps within 5% of tracing-disabled on the SCHED-shaped
+    # workload (ratio gate: one isolation rerun), every query must land
+    # a trace, and the injected-latency slow-query log line must fire
+    # with its stage breakdown (deterministic: never retried).
+    obs = detail["obs"]
+    assert obs["slow_query_logged"], obs
+    assert obs["slow_query"]["has_breakdown"], obs
+    assert obs["traced_all"], obs
+    obs = _retry_ratio_gate("OBS", obs, lambda o: o["obs_ok"], tmp_path)
+    assert obs["obs_ok"], obs
 
     # BENCH_OUT got the same line atomically.
+    out_path = tmp_path / "bench_out.json"
     assert json.loads(out_path.read_text())["detail"]["mixed"]["delta_ok"]

@@ -646,6 +646,148 @@ class TestFailpointHygiene:
         assert fired <= doc_names, fired - doc_names
 
 
+# ---------------------------------------------------------------- R7
+
+
+class TestSpanHygiene:
+    def _env(self, docs=("parse", "gather"), records=("parse", "gather")):
+        env = RepoEnv()
+        env.span_docs_loaded = True
+        env.span_doc_names = set(docs)
+        env.span_record_sites = set(records)
+        return env
+
+    def test_undocumented_span_site_is_violation(self):
+        vs = lint("""
+            from ..obs import span as obs_span
+
+            def f():
+                with obs_span("gathr"):
+                    work()
+        """, env=self._env(), rules=["R7"])
+        assert codes(vs) == ["R7"]
+
+    def test_documented_span_site_is_fine(self):
+        vs = lint("""
+            from ..obs import span as obs_span, record as obs_record
+
+            def f():
+                with obs_span("gather"):
+                    work()
+                obs_record("parse", 1.0)
+        """, env=self._env(), rules=["R7"])
+        assert vs == []
+
+    def test_dynamic_span_name_not_checked(self):
+        # remote:<peer> hops are f-strings: statically unverifiable,
+        # documented for humans, never a violation.
+        vs = lint("""
+            def f(trace, target):
+                with trace.span(f"remote:{target.id}"):
+                    work()
+        """, env=self._env(), rules=["R7"])
+        assert vs == []
+
+    def test_annotation_suppresses_span_site(self):
+        vs = lint("""
+            from ..obs import span as obs_span
+
+            def f():
+                # pilint: allow-span(internal-only stage, not operator-facing)
+                with obs_span("secret.stage"):
+                    work()
+        """, env=self._env(), rules=["R7"])
+        assert vs == []
+
+    def test_docs_not_loaded_no_ops(self):
+        env = RepoEnv()  # span_docs_loaded stays False
+        vs = lint("""
+            from ..obs import span as obs_span
+
+            def f():
+                with obs_span("whatever"):
+                    work()
+        """, env=env, rules=["R7"])
+        assert vs == []
+
+    def test_outside_pilosa_tpu_not_checked(self):
+        vs = lint("""
+            span("anything-goes")
+        """, path="bench.py", env=self._env(), rules=["R7"])
+        assert vs == []
+
+    def test_orphan_asserted_span_is_violation(self):
+        from tools.pilint.rules import (collect_span_assert_sites,
+                                        span_orphan_violations)
+
+        env = self._env(records=("parse",))
+        env.span_assert_sites = collect_span_assert_sites(
+            "tests/test_x.py", textwrap.dedent("""
+                def test_t(trace):
+                    find_span(trace, "gathr")  # pilint: allow-span(fixture negative for this self-test)
+
+                    assert_span(trace, "gathre")
+            """))
+        vs = span_orphan_violations(env)
+        assert codes(vs) == ["R7"]
+        assert "gathre" in vs[0].message
+
+    def test_asserted_span_with_record_site_is_fine(self):
+        from tools.pilint.rules import (collect_span_assert_sites,
+                                        span_orphan_violations)
+
+        env = self._env(records=("parse", "gather"))
+        env.span_assert_sites = collect_span_assert_sites(
+            "tests/test_x.py", textwrap.dedent("""
+                def test_t(trace):
+                    assert_span(trace, "gather")
+            """))
+        assert span_orphan_violations(env) == []
+
+    def test_docs_table_parser_reads_span_section(self):
+        from tools.pilint.rules import parse_span_docs
+
+        names = parse_span_docs(textwrap.dedent("""
+            ## Something else
+
+            | `not-a-span` | x |
+
+            ## Span reference
+
+            | span | recorded at |
+            |---|---|
+            | `parse` | executor |
+            | `remote:<peer>` | client hop |
+
+            ## After
+
+            | `also-not` | y |
+        """))
+        assert names == {"parse", "remote:<peer>"}
+
+    def test_real_tree_docs_cover_every_span_site(self):
+        """The shipped span table and the shipped recording sites agree:
+        every constant span name recorded anywhere in pilosa_tpu/ has a
+        row in docs/observability.md."""
+        from tools.pilint.rules import collect_span_names, parse_span_docs
+        import ast, glob
+
+        with open(os.path.join(REPO_ROOT, "docs", "observability.md")) as f:
+            doc_names = parse_span_docs(f.read())
+        recorded = set()
+        for path in glob.glob(
+                os.path.join(REPO_ROOT, "pilosa_tpu", "**", "*.py"),
+                recursive=True):
+            with open(path) as f:
+                recorded |= collect_span_names(ast.parse(f.read()))
+        assert recorded, "no span recording sites found — collection broke"
+        assert recorded <= doc_names, recorded - doc_names
+        # And every acceptance stage actually records somewhere.
+        for name in ("parse", "sched.wait", "batch.hold", "executor.fanout",
+                     "gather", "device.dispatch", "tier.promote", "reduce"):
+            assert name in recorded, name
+
+
 # ------------------------------------------------------- annotation grammar
 
 

@@ -24,7 +24,11 @@ from typing import Dict, List, Optional, Tuple
 # "failpoint" is shared by both halves of R6: on a fire() site it excuses
 # a name kept out of the docs table, and in a TEST file it marks a
 # deliberately-bogus spec (registry/grammar tests) as not-a-typo.
-KNOWN_KINDS = ("swallow", "blocking", "counter", "mutation", "failpoint")
+# "span" mirrors it for R7: on a recording site it excuses a span name
+# kept out of docs/observability.md's table, and in a TEST file it marks
+# a deliberately-bogus asserted name (fixture negatives) as not-a-typo.
+KNOWN_KINDS = ("swallow", "blocking", "counter", "mutation", "failpoint",
+               "span")
 
 _ANNOT_RE = re.compile(
     r"#\s*pilint:\s*allow-(?P<kind>[a-z][a-z-]*)\((?P<reason>[^)]*)\)"

@@ -40,6 +40,16 @@ class RepoEnv:
     failpoint_spec_sites: (path, line, name) of every failpoint name a
         test activates/configures, with allow-failpoint-annotated lines
         already filtered out.
+    span_doc_names: span names listed in docs/observability.md's span
+        reference table (R7: every recorder span name must appear there).
+    span_docs_loaded: True when that doc was actually read — R7's
+        recording-site half no-ops otherwise (fixture runs).
+    span_record_sites: every constant span name passed to a recorder call
+        across pilosa_tpu/ (R7: a name a test asserts on must name one of
+        these — a typo'd assertion tests a span that never records).
+    span_assert_sites: (path, line, name) of every span name a test
+        asserts on (assert_span/find_span helper calls), allow-span-
+        annotated lines already filtered out.
     """
 
     wired_literals: Set[str] = field(default_factory=set)
@@ -48,11 +58,17 @@ class RepoEnv:
     failpoint_docs_loaded: bool = False
     failpoint_fire_sites: Set[str] = field(default_factory=set)
     failpoint_spec_sites: List = field(default_factory=list)
+    span_doc_names: Set[str] = field(default_factory=set)
+    span_docs_loaded: bool = False
+    span_record_sites: Set[str] = field(default_factory=set)
+    span_assert_sites: List = field(default_factory=list)
 
 
 WIRING_FILES = ("pilosa_tpu/server/handler.py", "pilosa_tpu/diagnostics.py")
 # R6's reference table lives in the durability doc (the failpoint section).
 FAILPOINT_DOC = "docs/durability.md"
+# R7's reference table lives in the observability doc (the span section).
+SPAN_DOC = "docs/observability.md"
 
 
 def build_env(sources: Dict[str, str]) -> RepoEnv:
@@ -169,6 +185,7 @@ JAX_FREE_ZONES = (
     "pilosa_tpu/tier/__init__.py",
     "pilosa_tpu/parallel/__init__.py",
     "pilosa_tpu/sched/",
+    "pilosa_tpu/obs/",
 )
 
 
@@ -538,6 +555,127 @@ def failpoint_orphan_violations(env: RepoEnv) -> List[Violation]:
 
 
 # --------------------------------------------------------------------------
+# R7: span-name hygiene
+
+
+# The recorder surface (pilosa_tpu/obs/): obs.span()/obs_span() open a
+# stage span, obs.record()/obs_record()/trace.record() append a
+# pre-measured one. Only CONSTANT first-arg names are checked — dynamic
+# names (the f-string `remote:<peer>` hops) can't be validated statically
+# and are documented in the table for humans, not the linter.
+_SPAN_CALL_FUNCS = {"span", "obs_span", "record", "obs_record"}
+# Test-side assertion helpers whose span-name argument R7b validates:
+# a trace-shaped assertion naming a span nothing records is a no-op test.
+_SPAN_ASSERT_FUNCS = {"assert_span", "find_span", "find_spans"}
+_SPAN_NAME = r"[a-z][a-z0-9_.:<>-]*"
+
+
+def parse_span_docs(text: str) -> Set[str]:
+    """Span names from the reference table in docs/observability.md:
+    table rows (lines starting with `|`) inside a `## ... span ...`
+    section whose first cell is a backticked name."""
+    names: Set[str] = set()
+    in_section = False
+    for line in text.splitlines():
+        if line.startswith("## "):
+            in_section = "span" in line.lower()
+            continue
+        if in_section:
+            m = re.match(rf"\|\s*`({_SPAN_NAME})`", line)
+            if m:
+                names.add(m.group(1))
+    return names
+
+
+def _span_call_name(node: ast.Call):
+    """The constant span name of a recorder call, or None."""
+    if (terminal_name(node.func) in _SPAN_CALL_FUNCS and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)):
+        return node.args[0].value
+    return None
+
+
+def collect_span_names(tree: ast.AST) -> Set[str]:
+    """Every constant span name recorded anywhere in a module."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _span_call_name(node)
+            if name is not None:
+                out.add(name)
+    return out
+
+
+def collect_span_assert_sites(path: str, source: str) -> List:
+    """(path, line, name) for every span name a test asserts on: constant
+    string args of assert_span()/find_span() helper calls. Lines carrying
+    `# pilint: allow-span(reason)` are excluded — fixture negatives use
+    deliberately-bogus names."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    annotations, _ = parse_annotations(path, source)
+    ctx = FileContext(path=path, source=source, tree=tree,
+                      annotations=annotations)
+    out: List = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and terminal_name(node.func) in _SPAN_ASSERT_FUNCS):
+            for arg in node.args:
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and re.fullmatch(_SPAN_NAME, arg.value)
+                        and not ctx.allowed(node.lineno, "span")):
+                    out.append((path, node.lineno, arg.value))
+    return out
+
+
+def rule_span_hygiene(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    """R7a: every constant span name passed to the recorder in
+    pilosa_tpu/ must appear in docs/observability.md's span reference
+    table — the table is how operators (and the trace-shaped tests)
+    discover stage names, and an undocumented span is one nobody will
+    filter or alert on."""
+    if not ctx.path.startswith("pilosa_tpu/") or not env.span_docs_loaded:
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _span_call_name(node)
+        if name is None or name in env.span_doc_names:
+            continue
+        if ctx.allowed(node.lineno, "span"):
+            continue
+        out.append(Violation(
+            ctx.path, node.lineno, "R7", "span-hygiene",
+            f"span {name!r} is recorded here but missing from the span "
+            f"reference table in {SPAN_DOC} — add a table row or annotate "
+            "`# pilint: allow-span(reason)`",
+        ))
+    return out
+
+
+def span_orphan_violations(env: RepoEnv) -> List[Violation]:
+    """R7b (repo-level, emitted by the runner after per-file rules): every
+    span name a test asserts on must have a recording site — a typo'd
+    assertion waits on a span that never records, silently turning a
+    trace-shaped test into a no-op."""
+    out: List[Violation] = []
+    for path, line, name in env.span_assert_sites:
+        if name not in env.span_record_sites:
+            out.append(Violation(
+                path, line, "R7", "span-hygiene",
+                f"test asserts on span {name!r} but no recording site "
+                "carries that name — the assertion can never match; fix "
+                "the name or annotate `# pilint: allow-span(reason)`",
+            ))
+    return out
+
+
+# --------------------------------------------------------------------------
 # R5: mutation-epoch audit (core/ only)
 
 
@@ -619,4 +757,5 @@ ALL_RULES = (
     ("R4", rule_counter_hygiene),
     ("R5", rule_mutation_epoch),
     ("R6", rule_failpoint_hygiene),
+    ("R7", rule_span_hygiene),
 )

@@ -8,9 +8,11 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from .core import (FileContext, Violation, parse_annotations,
                    unused_annotation_violations)
-from .rules import (ALL_RULES, FAILPOINT_DOC, RepoEnv, WIRING_FILES,
-                    build_env, collect_fire_names, collect_spec_sites,
-                    failpoint_orphan_violations, parse_failpoint_docs)
+from .rules import (ALL_RULES, FAILPOINT_DOC, RepoEnv, SPAN_DOC, WIRING_FILES,
+                    build_env, collect_fire_names, collect_span_assert_sites,
+                    collect_span_names, collect_spec_sites,
+                    failpoint_orphan_violations, parse_failpoint_docs,
+                    parse_span_docs, span_orphan_violations)
 
 _SKIP_PARTS = {"__pycache__", ".git"}
 
@@ -100,6 +102,36 @@ def _load_failpoint_env(env: RepoEnv, root: str) -> None:
                 collect_spec_sites(_relpath(f, root), src))
 
 
+def _load_span_env(env: RepoEnv, root: str) -> None:
+    """R7's cross-file corpus, mirroring R6's: the span reference table
+    in docs/observability.md, every constant recorder span name under
+    pilosa_tpu/, and every span name tests assert on under tests/."""
+    import ast as _ast
+
+    doc = os.path.join(root, SPAN_DOC)
+    if os.path.exists(doc):
+        with open(doc, "r", encoding="utf-8") as f:
+            env.span_doc_names = parse_span_docs(f.read())
+        env.span_docs_loaded = True
+    for f in _discover([os.path.join(root, "pilosa_tpu")]):
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                env.span_record_sites |= collect_span_names(
+                    _ast.parse(fh.read()))
+        except (OSError, SyntaxError):
+            continue  # unreadable/unparseable files get their own E0
+    tests_dir = os.path.join(root, "tests")
+    if os.path.isdir(tests_dir):
+        for f in _discover([tests_dir]):
+            try:
+                with open(f, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+            except OSError:
+                continue
+            env.span_assert_sites.extend(
+                collect_span_assert_sites(_relpath(f, root), src))
+
+
 def lint_paths(paths: Iterable[str], repo_root: Optional[str] = None,
                rules: Optional[Iterable[str]] = None) -> List[Violation]:
     """Lint every .py file under `paths`. repo_root anchors the relative
@@ -116,11 +148,15 @@ def lint_paths(paths: Iterable[str], repo_root: Optional[str] = None,
     selected = set(rules) if rules else None
     if selected is None or "R6" in selected:
         _load_failpoint_env(env, root)
+    if selected is None or "R7" in selected:
+        _load_span_env(env, root)
     out: List[Violation] = []
     for f in files:
         out.extend(lint_file(f, env, repo_root=root, rules=rules))
     if selected is None or "R6" in selected:
         out.extend(failpoint_orphan_violations(env))
+    if selected is None or "R7" in selected:
+        out.extend(span_orphan_violations(env))
     return sorted(out, key=Violation.sort_key)
 
 
