@@ -2304,6 +2304,148 @@ def bench_tier():
     return out
 
 
+def bench_compile():
+    """Query-plan compiler (docs/query-compiler.md): whole PQL trees
+    lowered into ONE fused, batched device program vs the reference
+    per-op/per-shard dispatch walk — the ROADMAP item 2 acceptance
+    metric. The pool holds deep trees in several commutative/associative
+    respellings, so the canonical plan maps every respelling onto one
+    compiled program and one memo space; the per-op path re-walks each
+    spelling op by op, shard by shard. Also asserts compiled results
+    bit-exact against the host ladder, including a seed-pinned chaos leg
+    where the fused program's SIGNATURE breaker opens mid-run
+    (device-sig-failures=1, one injected dispatch error) and the ladder
+    keeps serving the same answers."""
+    from pilosa_tpu import failpoints
+    from pilosa_tpu.cluster.health import ResilienceConfig
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.plan import snapshot as plan_snapshot
+    from pilosa_tpu.pql.parser import parse
+
+    n_shards = 2 if SMOKE else 8
+    n_rows = 8 if SMOKE else 64
+    density = float(os.environ.get("BENCH_DENSITY", "0.02"))
+    holder, ex = build(n_shards, n_rows, density)
+    shards = list(range(n_shards))
+    out = {"shards": n_shards, "rows": n_rows}
+    # Read NOW, restored in the outer finally; the dispatch-floor leg
+    # below overrides it (engines read the env at lazy construction).
+    old_memo = os.environ.get("PILOSA_MEMO_ENTRIES")
+    # Seed-pinned: the chaos leg below replays the identical workload.
+    rng = np.random.default_rng(1103)
+
+    pool = []
+    for _ in range(8):
+        a, b, c, d = (int(x) for x in
+                      rng.choice(n_rows, size=4, replace=False))
+        pool.append((
+            f"Count(Intersect(Union(Row(f={a}), Row(f={b})), "
+            f"Row(f={c}), Row(f={d})))",
+            f"Count(Intersect(Row(f={d}), Union(Row(f={b}), Row(f={a})), "
+            f"Row(f={c})))",
+            f"Count(Intersect(Intersect(Row(f={c}), Row(f={d})), "
+            f"Union(Row(f={a}), Row(f={b}))))",
+        ))
+    queries = [q for group in pool for q in group]
+    child_trees = [parse(q).calls[0].children[0] for q in queries]
+
+    plan0 = plan_snapshot()
+    eng0 = ex.engine.snapshot()
+
+    def run_fused():
+        return [int(ex.execute("bench", q)[0]) for q in queries]
+
+    def run_per_op():
+        # The reference walk the compiler replaces: one dispatch per op
+        # per shard, merged pairwise on the host.
+        res = []
+        for t in child_trees:
+            total = 0
+            for s in shards:
+                total += ex._execute_bitmap_call_shard("bench", t, s).count()
+            res.append(total)
+        return res
+
+    try:
+        fused0 = run_fused()  # warmup: compiles the canonical program(s)
+        per0 = run_per_op()
+        host = [ex.engine.host_count("bench", t, shards)
+                for t in child_trees]
+        out["bit_exact"] = fused0 == per0 == host
+
+        def timed(fn):
+            done = 0
+            t0 = time.perf_counter()
+            while (done < _LOOP_MIN * len(queries)
+                   or time.perf_counter() - t0 < _LOOP_SECS):
+                fn()
+                done += len(queries)
+            return round(done / (time.perf_counter() - t0), 1)
+
+        # Headline: the PRODUCTION fused path, memo on. The canonical-
+        # signature result memo is part of what the compiler buys (all
+        # respellings share one entry — per-op dispatch structurally has
+        # no equivalent), so the serving-shape ratio includes it.
+        out["fused_qps"] = timed(run_fused)
+        out["per_op_qps"] = timed(run_per_op)
+        out["fused_vs_per_op"] = round(
+            out["fused_qps"] / max(out["per_op_qps"], 1e-9), 2)
+        plan1 = plan_snapshot()
+        eng1 = ex.engine.snapshot()
+        out["plan"] = {k: plan1[k] - plan0.get(k, 0) for k in plan1}
+        # All 24 respellings canonicalize onto ONE signature, so the
+        # compiled-program cache builds once and hits thereafter.
+        out["fn_cache_builds"] = (eng1["fn_cache_builds"]
+                                  - eng0.get("fn_cache_builds", 0))
+
+        # ---- dispatch floor, memo OFF: a regression that makes the
+        # lowered program itself slower could hide behind memo hits in
+        # the headline ratio, so ALSO measure the raw per-query fused
+        # dispatch (every query a real compiled-program launch) and gate
+        # it against per-op as a floor. The engine reads the env at lazy
+        # construction, hence a fresh executor; the chaos executor below
+        # rides the same override (a memo hit dispatches nothing and
+        # would starve the breaker of evidence).
+        os.environ["PILOSA_MEMO_ENTRIES"] = "0"
+        ex_nm = Executor(holder)
+        try:
+            nm = [int(ex_nm.execute("bench", q)[0]) for q in queries]
+            assert nm == fused0  # warmup, and the dispatch path agrees
+            out["fused_dispatch_qps"] = timed(
+                lambda: [ex_nm.execute("bench", q) for q in queries])
+            out["dispatch_vs_per_op"] = round(
+                out["fused_dispatch_qps"] / max(out["per_op_qps"], 1e-9), 2)
+        finally:
+            ex_nm.close()
+
+        # ---- chaos leg: signature breaker opens MID-RUN, ladder serves
+        # the same answers. Fresh executor so the sig-breaker config is
+        # in place before ITS engine lazily constructs.
+        ex2 = Executor(holder)
+        try:
+            ex2.cluster.health.configure(ResilienceConfig(
+                device_sig_failures=1, device_sig_backoff=60.0).validate())
+            baseline = [int(ex2.execute("bench", q)[0]) for q in queries]
+            failpoints.configure("device-dispatch", "error", count=1)
+            chaos = [int(ex2.execute("bench", q)[0]) for q in queries]
+            dh = ex2.engine.device_health.snapshot()
+            out["chaos"] = {
+                "bit_exact": chaos == baseline == fused0,
+                "sig_quarantined": dh.get("sig_quarantined", 0),
+            }
+        finally:
+            failpoints.reset()
+            ex2.close()
+    finally:
+        if old_memo is None:
+            os.environ.pop("PILOSA_MEMO_ENTRIES", None)
+        else:
+            os.environ["PILOSA_MEMO_ENTRIES"] = old_memo
+        ex.close()
+        holder.close()
+    return out
+
+
 # Every optional stanza, in run order. THE registry: main() runs exactly
 # these, the FINAL JSON line carries a key per entry (lowercased), and
 # tests/test_bench_smoke.py asserts every name is present — a stanza
@@ -2318,6 +2460,7 @@ STANZAS = (
     ("INGEST", bench_ingest),
     ("SERVING", bench_serving),
     ("SCHED", bench_sched),
+    ("COMPILE", bench_compile),
     ("OBS", bench_obs),
     ("MIXED", bench_mixed),
     ("FAULT", bench_fault),
@@ -2457,12 +2600,28 @@ def main():
     # default-platform override is dead): the child run gets pinned to it.
     tpu_platform_arg = {"explicit": None}
 
+    def bounded_probe_timeout(t):
+        """Probe timeout clipped to the REMAINING deadline. r04 burned its
+        probe budget in the first minutes and r05 timed out with
+        `parsed: null`; EVERY probe — foreground, background, require-tpu
+        retry — now spends at most a quarter of what's left, so a dead
+        tunnel can never eat the stanzas' window."""
+        if deadline <= 0:
+            return t
+        left = deadline - (time.time() - t_start)
+        return max(10, min(int(t), int(left * 0.25)))
+
     def probe_round(n, timeout):
         """One spread-probe attempt: the default platform, then — every
         other round — the explicit 'tpu'/'axon' names, recovering from a
         dead default-platform override (the old bring-up probed 'tpu'
         explicitly once; keep that capability in the spread design).
-        Returns True when a TPU answered."""
+        Returns True when a TPU answered. Each probe is bounded by the
+        remaining deadline; with under a minute left there is no window
+        worth handing to a TPU child, so the round refuses outright."""
+        if deadline > 0 and time.time() - t_start >= deadline - 60:
+            return False
+        timeout = bounded_probe_timeout(timeout)
         diag = _probe_once(None, timeout)
         diag["attempt"] = n
         probes.append(diag)
@@ -2470,7 +2629,8 @@ def main():
             return True
         if n % 2 == 0:
             for explicit in tpu_platforms:
-                d2 = _probe_once(explicit, min(timeout, 60))
+                d2 = _probe_once(explicit, bounded_probe_timeout(
+                    min(timeout, 60)))
                 d2["attempt"] = n
                 probes.append(d2)
                 if d2.get("ok"):
@@ -2583,6 +2743,10 @@ def main():
         recorded `parsed: null` because all output waited for the end)."""
         if os.environ.get(f"BENCH_{name}") == "0":
             return {"skipped": f"BENCH_{name}=0"}
+        # Checkpoint BEFORE the stanza too: when a stanza wedges past the
+        # driver's deadline, the last parseable line now NAMES it (r05's
+        # `parsed: null` left no clue which stanza died).
+        emit_partial(f"entering stanza {name}")
         try:
             out = fn()
         except Exception as e:

@@ -214,6 +214,12 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    help="1 answers a one-off Count on fully-demoted planes "
                         "straight from the compressed host tier (no decode "
                         "+ device_put); 0 disables")
+    p.add_argument("--engine-plan-cache",
+                   dest="engine_plan_cache", type=int,
+                   metavar="{0,1}",
+                   help="1 caches each query tree's canonical plan "
+                        "(signature + lowering) on the Call, keyed by the "
+                        "index write epoch; 0 recompiles per dispatch site")
     p.add_argument("--tier-hbm-bytes", dest="tier_hbm_bytes", type=int,
                    help="combined device-cache budget split across the "
                         "leaf/stack caches (0 = platform default)")

@@ -273,6 +273,8 @@ class Config:
             "dispatch-watchdog", self.engine.dispatch_watchdog)
         self.engine.cold_host_count = e.get(
             "cold-host-count", self.engine.cold_host_count)
+        self.engine.plan_cache = e.get(
+            "plan-cache", self.engine.plan_cache)
         ti = d.get("tier", {})
         self.tier.hbm_bytes = ti.get("hbm-bytes", self.tier.hbm_bytes)
         self.tier.host_bytes = ti.get("host-bytes", self.tier.host_bytes)
@@ -417,6 +419,7 @@ class Config:
             ("aux_memo_entries", "ENGINE_AUX_MEMO_ENTRIES", int),
             ("dispatch_watchdog", "ENGINE_DISPATCH_WATCHDOG", float),
             ("cold_host_count", "ENGINE_COLD_HOST_COUNT", int),
+            ("plan_cache", "ENGINE_PLAN_CACHE", int),
         ]:
             v = env(name, cast)
             if v is not None:
@@ -521,6 +524,7 @@ class Config:
             "engine_aux_memo_entries": ("engine", "aux_memo_entries"),
             "engine_dispatch_watchdog": ("engine", "dispatch_watchdog"),
             "engine_cold_host_count": ("engine", "cold_host_count"),
+            "engine_plan_cache": ("engine", "plan_cache"),
             "tier_hbm_bytes": ("tier", "hbm_bytes"),
             "tier_host_bytes": ("tier", "host_bytes"),
             "tier_disk_bytes": ("tier", "disk_bytes"),

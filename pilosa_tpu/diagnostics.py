@@ -85,6 +85,18 @@ class DiagnosticsCollector:
             snap = batcher.snapshot()
             info["schedBatchLaunches"] = snap.get("launches", 0)
             info["schedBatchCoalesced"] = snap.get("coalesced", 0)
+        # Query-plan compiler shape (docs/query-compiler.md): cache hits
+        # dwarfing builds means the per-query canonical lowering is being
+        # reused across dispatch sites; reorders/flattens nonzero means
+        # canonicalization is actively collapsing respelled query shapes
+        # onto shared compiled programs.
+        from .plan import snapshot as _plan_snapshot
+
+        snap = _plan_snapshot()
+        info["planBuilds"] = snap.get("plan_builds", 0)
+        info["planCacheHits"] = snap.get("plan_cache_hits", 0)
+        info["planReorders"] = snap.get("plan_reorders", 0)
+        info["planFlattens"] = snap.get("plan_flattens", 0)
         # Delta-refresh health under mixed read/write traffic: a deployment
         # whose deltaBytes stays tiny next to fullRefreshBytes is keeping
         # its HBM caches warm through writes; the inverse means writes are

@@ -886,7 +886,7 @@ class Executor:
         # hottest serving path (True means a patched/syntactic gate:
         # let the engine compile internally).
         compiled = None if supported is True else supported
-        health_sig = tuple(compiled[0].signature) if compiled else None
+        health_sig = compiled[0].plan.sig_tuple if compiled else None
         route = self.engine.device_health.plan(health_sig)
         if route == "shard":
             # Per-signature quarantine: THIS structure keeps failing on
@@ -954,6 +954,14 @@ class Executor:
                                 comp_expr=compiled, deadline=opt.deadline)
                         return self.engine.count(
                             index, target, local_shards, comp_expr=compiled)
+                    if self.batcher is not None:
+                        # Generalized micro-batching: bitmap dispatches
+                        # coalesce with same-canonical-signature peers
+                        # into one fused bitmap_batch launch, exactly
+                        # like Counts (docs/query-compiler.md).
+                        return self.batcher.bitmap(
+                            index, target, local_shards,
+                            comp_expr=compiled, deadline=opt.deadline)
                     return self.engine.bitmap(
                         index, target, local_shards, comp_expr=compiled)
             except DeviceDispatchError as e:

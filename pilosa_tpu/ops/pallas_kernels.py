@@ -20,6 +20,14 @@ scalar-prefetched block indices and streams at ~95% of peak.
 On non-TPU backends (CPU tests) the kernel runs in Pallas interpret mode;
 on TPU the engine gates it in for single-device meshes
 (parallel/engine.py:_use_gather_kernel).
+
+BENCH_r03 measured this tier at ~0.7x of the plain-XLA fused path on the
+only full TPU capture; the kernel was rewritten around in-kernel
+popcount accumulation (VMEM scratch across the W loop, one output write
+per query) and k-ary operand evaluation of the canonical plan's
+flattened trees. The keep-vs-delete decision rule — beat the XLA
+formulation in the next hardware capture (BENCH_r06's pallas_vs_xla) or
+be deleted — is recorded in docs/query-compiler.md.
 """
 
 from __future__ import annotations
@@ -56,9 +64,25 @@ def batched_gather_expr_count(stacked, idxs, expr):
     `stacked` is the resident (U, S, W) uint32 leaf stack, `idxs` is a tuple
     of L (Q,) int32 leaf-slot vectors (one per leaf position of the
     compiled expression), `expr` an elementwise jnp function over L planes
-    (a PQL set-op tree). For query q the kernel computes
+    (a canonical PQL set-op tree, docs/query-compiler.md). For query q the
+    kernel computes
     popcount(expr(stacked[idxs[0][q]], ..., stacked[idxs[L-1][q]])) summed
     over shards and words.
+
+    Two in-kernel tricks close BENCH_r03's gap against plain XLA:
+
+    - **k-ary operand evaluation** (the arXiv:1103.2409 idea applied at
+      plane level): the plan compiler flattens associative chains, so
+      `expr` reduces ALL L operand planes of a node in one pass over the
+      gathered blocks — a k-wide Intersect is k-1 ANDs on VMEM-resident
+      data inside one grid step, never a pairwise tree of separate
+      kernels with materialized intermediates.
+    - **in-kernel popcount accumulation** (the accumulator discipline of
+      arXiv:1611.07612's vectorized popcounts): per-block popcount
+      partials accumulate in a VMEM scratch accumulator across the whole
+      W loop, and the HBM-backed output block is written ONCE per query
+      at the last block — the previous formulation read-modified-wrote
+      the output block every W chunk.
 
     The slot vectors are scalar-prefetched so the BlockSpec index maps DMA
     exactly each query's leaf blocks from HBM — the (Q, S, W) gathered
@@ -85,8 +109,9 @@ def batched_gather_expr_count(stacked, idxs, expr):
     n_wb = w // wc
 
     def kernel(*refs):
-        leaf_refs = refs[l:-1]
-        out_ref = refs[-1]
+        leaf_refs = refs[l:-2]
+        out_ref = refs[-2]
+        acc_ref = refs[-1]  # VMEM scratch accumulator, (8, 128) int32
         bi = pl.program_id(1)
         planes = tuple(r[0] for r in leaf_refs)  # (s, wc)
         pc = jax.lax.population_count(expr(planes)).astype(jnp.int32)
@@ -97,9 +122,15 @@ def batched_gather_expr_count(stacked, idxs, expr):
 
         @pl.when(bi == 0)
         def _():
-            out_ref[0] = jnp.zeros_like(out_ref[0])
+            acc_ref[...] = partial
 
-        out_ref[0] += partial
+        @pl.when(bi != 0)
+        def _():
+            acc_ref[...] += partial
+
+        @pl.when(bi == n_wb - 1)
+        def _():
+            out_ref[0] = acc_ref[...]
 
     def leaf_map(j):
         return lambda qi, bi, *idx_refs: (idx_refs[j][qi], 0, bi)
@@ -109,6 +140,7 @@ def batched_gather_expr_count(stacked, idxs, expr):
         grid=(q, n_wb),
         in_specs=[pl.BlockSpec((1, s, wc), leaf_map(j)) for j in range(l)],
         out_specs=pl.BlockSpec((1, 8, 128), lambda qi, bi, *idx_refs: (qi, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((8, 128), jnp.int32)],
     )
     out = pl.pallas_call(
         kernel,

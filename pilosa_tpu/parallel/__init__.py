@@ -55,3 +55,9 @@ class EngineConfig:
     # still climb back into HBM. 0 disables.
     dispatch_watchdog: float = 0.0
     cold_host_count: int = 1
+    # plan_cache: 1 caches each Call tree's canonical plan (signature +
+    # leaf slots + lowered expression, plan/signature.py) on the Call
+    # object, keyed by the index's write epoch — one lowering per query
+    # instead of one per dispatch site / shard batch / TopN chunk. 0
+    # recompiles every time (escape hatch).
+    plan_cache: int = 1

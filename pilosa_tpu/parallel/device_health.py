@@ -360,8 +360,24 @@ class DevicePlaneHealth:
         `device_plane` group) and diagnostics. Every key in
         self.counters is observable through here (pilint R4)."""
         with self._mu:
-            quarantined = sum(
-                1 for b in self._sigs.values() if b.state != CLOSED)
+            # WHICH canonical shapes are quarantined, not just how many:
+            # signatures are the canonical plan IR (docs/query-compiler.md),
+            # so the repr is a readable op tree an operator can match to a
+            # workload. Bounded — a pathological flood must not balloon a
+            # stats scrape.
+            # Bounded in BOTH dimensions (16 entries, 256 chars each),
+            # with the repr work stopping AT the entry bound: a
+            # pathological flood can hold _MAX_SIGS open breakers, and
+            # building 1024 multi-KB IR reprs under the health lock
+            # would block concurrent dispatch classification.
+            quarantined = 0
+            open_sigs = []
+            for sig, b in self._sigs.items():
+                if b.state == CLOSED:
+                    continue
+                quarantined += 1
+                if len(open_sigs) < 16:
+                    open_sigs.append(repr(sig)[:256])
             return {
                 **dict(self.counters),
                 "plane_state": self._plane.state,
@@ -369,4 +385,5 @@ class DevicePlaneHealth:
                 "plane_open_count": self._plane.open_count,
                 "sigs_tracked": len(self._sigs),
                 "sigs_open": quarantined,
+                "open_signatures": open_sigs,
             }

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,9 +28,13 @@ from .. import failpoints
 from ..constants import VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD, WORDS_PER_ROW
 from ..obs import NOP_SPAN, span as obs_span
 from ..core.row import Row
-from ..errors import FieldNotFoundError, BSIGroupNotFoundError, QueryError
+from ..errors import QueryError
 from ..ops import bitplane as bp
-from ..pql.ast import BETWEEN, Call, GT, GTE, LT, LTE, NEQ
+from ..plan.signature import (
+    CompiledPlan, Leaf, cached_plan, resolve_time_range as
+    _resolve_time_range,
+)
+from ..pql.ast import Call
 from . import EngineConfig
 from .device_health import (
     COMPILE, DeviceDispatchError, DeviceDispatchTimeout, DevicePlaneHealth,
@@ -47,207 +51,123 @@ def _pop_elems(a: np.ndarray) -> np.ndarray:
     return np.bitwise_count(a.view(np.uint16))
 
 
-class Leaf(NamedTuple):
-    """A fragment row that must be materialized on device. NamedTuple,
-    not frozen dataclass: Leaf construction/hash/eq run per call on the
-    batch-serving hot path (slot dicts, cache keys)."""
+def _lower_ir(ir: tuple) -> Callable:
+    """Canonical plan IR (plan/signature.py) -> jnp closure over the
+    (L, S, W) leaf tuple. The IR is already canonicalized (commutative
+    operands sorted, associative chains flattened to k-ary nodes), so
+    the lowered program reduces all k operands of a node in one chained
+    pass — XLA fuses the whole thing into a single elementwise kernel —
+    and a Difference pays ONE complement for its whole subtracting set
+    (head AND NOT(OR(tail))) instead of one per operand."""
+    kind = ir[0]
+    if kind == "leaf":
+        i = ir[1]
+        return lambda leaves: leaves[i]
+    if kind in ("Intersect", "Union", "Xor"):
+        subs = [_lower_ir(ch) for ch in ir[1]]
+        op = {
+            "Intersect": jnp.bitwise_and,
+            "Union": jnp.bitwise_or,
+            "Xor": jnp.bitwise_xor,
+        }[kind]
 
-    field: str
-    view: str
-    row: int
+        def fn(leaves, subs=subs, op=op):
+            out = subs[0](leaves)
+            for s in subs[1:]:
+                out = op(out, s(leaves))
+            return out
 
+        return fn
+    if kind == "Difference":
+        head = _lower_ir(ir[1])
+        tails = [_lower_ir(ch) for ch in ir[2]]
+        if not tails:
+            return head
 
-def _resolve_time_range(holder, index: str, c: Call):
-    """(field_name, row_id, present views) for a time-quantum Range call
-    — THE one implementation of the argument parsing and present-view
-    pruning, shared by the compiled fast path and the host evaluator.
-    The degraded host answer must match the compiled program bit for
-    bit, so the view set they union over cannot be allowed to diverge."""
-    from ..timeq import parse_timestamp, views_by_time_range
+        def fn(leaves, head=head, tails=tails):
+            mask = tails[0](leaves)
+            for t in tails[1:]:
+                mask = jnp.bitwise_or(mask, t(leaves))
+            return jnp.bitwise_and(head(leaves), jnp.bitwise_not(mask))
 
-    field_name = c.field_arg()
-    fld = holder.field(index, field_name)
-    if fld is None:
-        raise FieldNotFoundError(field_name)
-    row_id, ok = c.uint_arg(field_name)
-    if not ok:
-        raise QueryError("Range() must specify row")
-    start = c.args.get("_start")
-    end = c.args.get("_end")
-    if not isinstance(start, str) or not isinstance(end, str):
-        raise QueryError("Range() start/end time required")
-    q = fld.time_quantum()
-    if not q:
-        raise QueryError("Range() field has no time quantum")
-    views = views_by_time_range(
-        VIEW_STANDARD, parse_timestamp(start), parse_timestamp(end), q
-    )
-    # Prune to views that exist in the field: an hour-quantum range
-    # over years enumerates tens of thousands of view names, and a
-    # leaf per ABSENT view would materialize a zero plane per shard
-    # (the per-shard fallback just skips missing fragments). Present
-    # views bound the work to actual data.
-    return field_name, row_id, [v for v in views if fld.view(v) is not None]
+        return fn
+    if kind == "timerange":
+        idxs = ir[1]
 
-
-class _Compiler:
-    """AST -> (leaves, expression builder). The builder is pure jnp over a
-    (L, S, W) leaf tensor, so the jitted program is cacheable per structure
-    signature (predicates are baked in and included in the signature)."""
-
-    def __init__(self, holder, index: str, field_cache: Optional[Dict] = None):
-        self.holder = holder
-        self.index = index
-        self.leaves: List[Leaf] = []
-        self._slots: Dict[Leaf, int] = {}
-        self.signature: List = []
-        # Shared across one batch's compilers: a 1024-query batch would
-        # otherwise repeat the same holder field-existence lookups per call.
-        self._field_cache = field_cache
-
-    def leaf_index(self, leaf: Leaf) -> int:
-        # Dict, not list.index: compilation is per-call serving-path work
-        # (a 1024-query batch compiles 1024 trees), and the linear scan
-        # was the single largest host cost in batch assembly.
-        i = self._slots.get(leaf)
-        if i is None:
-            i = len(self.leaves)
-            self.leaves.append(leaf)
-            self._slots[leaf] = i
-        return i
-
-    def _field_exists(self, field_name: str) -> bool:
-        fc = self._field_cache
-        if fc is not None:
-            ok = fc.get(field_name)
-            if ok is None:
-                ok = self.holder.field(self.index, field_name) is not None
-                fc[field_name] = ok
-            return ok
-        return self.holder.field(self.index, field_name) is not None
-
-    def compile(self, c: Call) -> Callable:
-        if c.name == "Row":
-            field_name = c.field_arg()
-            if not self._field_exists(field_name):
-                raise FieldNotFoundError(field_name)
-            row_id, ok = c.uint_arg(field_name)
-            if not ok:
-                raise QueryError("Row() must specify row")
-            i = self.leaf_index(Leaf(field_name, VIEW_STANDARD, row_id))
-            self.signature.append(("row", i))
-            return lambda leaves: leaves[i]
-        if c.name in ("Intersect", "Union", "Difference", "Xor"):
-            if not c.children:
-                raise QueryError(f"empty {c.name} query is currently not supported")
-            subs = [self.compile(ch) for ch in c.children]
-            op = {
-                "Intersect": jnp.bitwise_and,
-                "Union": jnp.bitwise_or,
-                "Difference": lambda a, b: jnp.bitwise_and(a, jnp.bitwise_not(b)),
-                "Xor": jnp.bitwise_xor,
-            }[c.name]
-            self.signature.append((c.name, len(c.children)))
-
-            def fn(leaves, subs=subs, op=op):
-                out = subs[0](leaves)
-                for s in subs[1:]:
-                    out = op(out, s(leaves))
-                return out
-
-            return fn
-        if c.name == "Range" and c.has_condition_arg():
-            return self._compile_bsi_range(c)
-        if c.name == "Range":
-            return self._compile_time_range(c)
-        raise QueryError(f"not fast-path compilable: {c.name}")
-
-    def _compile_time_range(self, c: Call) -> Callable:
-        """Time-quantum Range as a fast-path union over time-view leaves.
-
-        The executor's per-shard fallback merges one view at a time
-        (executor.py:_execute_time_range_shard, reference
-        executor.go:executeBitmapCallShard + fragment row per view); here
-        the whole view set becomes leaf planes of ONE compiled program, so
-        Count(Range(t=...)) over all shards is a single device dispatch
-        and composes with Intersect/Union/TopN-src like any other leaf.
-        An empty pruned view set refuses so supports() sends the executor
-        down the fallback."""
-        field_name, row_id, views = _resolve_time_range(
-            self.holder, self.index, c)
-        if not views:
-            raise QueryError("Range() covers no populated views")
-        if len(views) > 256:
-            raise QueryError("Range() spans too many views for the fast path")
-        idxs = [self.leaf_index(Leaf(field_name, v, row_id)) for v in views]
-        self.signature.append(("timerange", tuple(idxs)))
-
-        def fn(leaves):
+        def fn(leaves, idxs=idxs):
             out = leaves[idxs[0]]
             for i in idxs[1:]:
                 out = jnp.bitwise_or(out, leaves[i])
             return out
 
         return fn
+    if kind == "zero":
+        i = ir[1]
+        return lambda leaves: jnp.zeros_like(leaves[i])
+    if kind == "notnull":
+        i = ir[1]
+        return lambda leaves: leaves[i]
+    if kind == "between":
+        idxs, depth, lo, hi = ir[1], ir[2], ir[3], ir[4]
+        return lambda leaves: bp.bsi_range_between(
+            jnp.stack([leaves[i] for i in idxs]), depth, lo, hi)
+    if kind == "cmp":
+        _, op, idxs, depth, base = ir
 
-    def _compile_bsi_range(self, c: Call) -> Callable:
-        (field_name, cond), = c.args.items()
-        fld = self.holder.field(self.index, field_name)
-        if fld is None:
-            raise FieldNotFoundError(field_name)
-        bsig = fld.bsi_group(field_name)
-        if bsig is None:
-            raise BSIGroupNotFoundError(field_name)
-        depth = bsig.bit_depth()
-        view = VIEW_BSI_GROUP_PREFIX + field_name
-        idxs = [self.leaf_index(Leaf(field_name, view, i)) for i in range(depth + 1)]
-
-        zero_fn = lambda leaves: jnp.zeros_like(leaves[0])
-        not_null = lambda leaves: leaves[idxs[depth]]
-
-        if cond.op == NEQ and cond.value is None:
-            self.signature.append(("notnull", field_name))
-            return not_null
-
-        if cond.op == BETWEEN:
-            predicates = cond.int_slice_value()
-            lo, hi, out_of_range = bsig.base_value_between(*predicates)
-            self.signature.append(("between", field_name, lo, hi, out_of_range))
-            if out_of_range:
-                return zero_fn
-            if predicates[0] <= bsig.min and predicates[1] >= bsig.max:
-                return not_null
-            return lambda leaves: bp.bsi_range_between(
-                jnp.stack([leaves[i] for i in idxs]), depth, lo, hi
-            )
-
-        value = cond.value
-        if not isinstance(value, int) or isinstance(value, bool):
-            raise QueryError("Range(): conditions only support integer values")
-        base, out_of_range = bsig.base_value(cond.op, value)
-        self.signature.append((cond.op, field_name, base, out_of_range, value))
-        if out_of_range and cond.op != NEQ:
-            return zero_fn
-        if (
-            (cond.op == LT and value > bsig.max)
-            or (cond.op == LTE and value >= bsig.max)
-            or (cond.op == GT and value < bsig.min)
-            or (cond.op == GTE and value <= bsig.min)
-            or (out_of_range and cond.op == NEQ)
-        ):
-            return not_null
-
-        def fn(leaves):
+        def fn(leaves, op=op, idxs=idxs, depth=depth, base=base):
             planes = jnp.stack([leaves[i] for i in idxs])
-            if cond.op == "eq":
+            if op == "eq":
                 return bp.bsi_range_eq(planes, depth, base)
-            if cond.op == "neq":
+            if op == "neq":
                 return bp.bsi_range_neq(planes, depth, base)
-            if cond.op in ("lt", "lte"):
-                return bp.bsi_range_lt(planes, depth, base, cond.op == "lte")
-            return bp.bsi_range_gt(planes, depth, base, cond.op == "gte")
+            if op in ("lt", "lte"):
+                return bp.bsi_range_lt(planes, depth, base, op == "lte")
+            return bp.bsi_range_gt(planes, depth, base, op == "gte")
 
         return fn
+    raise QueryError(f"unknown plan IR node: {kind!r}")
+
+
+def _plan_expr(plan: CompiledPlan) -> Callable:
+    """Lowered closure for a plan, cached on the plan object (plans are
+    themselves cached on the Call tree, so a query's expression lowers
+    once per epoch, not once per dispatch site). Benign publication
+    race: concurrent lowerings produce equivalent closures."""
+    expr = plan.expr
+    if expr is None:
+        expr = plan.expr = _lower_ir(plan.ir)
+    return expr
+
+
+class _Compiler:
+    """Facade over the canonical plan compiler (plan/signature.py),
+    keeping the historical (comp, expr) surface: `comp.signature` (the
+    single-entry canonical-IR list), `comp.leaves` (canonical slot
+    order), `comp.plan`. Query structures that differ only by
+    commutative operand order or associative nesting now produce the
+    SAME signature and leaf binding, so they share one compiled program,
+    one memo space, one micro-batcher group, and one device breaker."""
+
+    def __init__(self, holder, index: str, field_cache: Optional[Dict] = None,
+                 plan_cache: bool = True):
+        self.holder = holder
+        self.index = index
+        self.leaves: List[Leaf] = []
+        self.signature: List = []
+        self.plan: Optional[CompiledPlan] = None
+        # Shared across one batch's compilers: a 1024-query batch would
+        # otherwise repeat the same holder field-existence lookups per call.
+        self._field_cache = field_cache
+        self._plan_cache = plan_cache
+
+    def compile(self, c: Call) -> Callable:
+        plan = cached_plan(self.holder, self.index, c,
+                           field_cache=self._field_cache,
+                           enabled=self._plan_cache)
+        self.plan = plan
+        self.leaves = plan.leaves
+        self.signature = plan.signature
+        return _plan_expr(plan)
 
 
 class ShardedQueryEngine:
@@ -282,6 +202,9 @@ class ShardedQueryEngine:
                 cold_host_count=int(os.environ.get(
                     "PILOSA_TPU_ENGINE_COLD_HOST_COUNT",
                     EngineConfig.cold_host_count)),
+                plan_cache=int(os.environ.get(
+                    "PILOSA_TPU_ENGINE_PLAN_CACHE",
+                    EngineConfig.plan_cache)),
             )
         if tier_config is None:
             # Same env-only fallback for the [tier] section.
@@ -310,6 +233,11 @@ class ShardedQueryEngine:
         self._watchdog_pool = None
         self._watchdog_inflight = 0
         self._cold_host = bool(int(getattr(config, "cold_host_count", 1)))
+        # On-Call canonical-plan caching (plan/signature.py cached_plan):
+        # 0 recompiles at every dispatch site — the escape hatch if a
+        # workload ever hits a stale-plan bug; the epoch token makes
+        # that structurally unlikely.
+        self._plan_cache_enabled = bool(int(getattr(config, "plan_cache", 1)))
         # Leaf sets already answered once by the cold-host path: the
         # second touch promotes normally so repeat traffic climbs back
         # into HBM instead of re-decoding per query. Bounded crudely —
@@ -397,6 +325,11 @@ class ShardedQueryEngine:
             "leaf_hits": 0, "leaf_misses": 0, "leaf_evictions": 0,
             "stack_hits": 0, "stack_misses": 0, "stack_evictions": 0,
             "memo_hits": 0, "memo_misses": 0,
+            # Compiled-program (XLA executable) cache traffic: the proof
+            # that canonicalized query shapes SHARE programs is
+            # fn_cache_hits climbing while fn_cache_builds stays flat
+            # across commutative/associative respellings of one tree.
+            "fn_cache_hits": 0, "fn_cache_builds": 0,
             # Device-program launches (memo hits dispatch nothing). The
             # scheduler's coalescing proof is dispatches/query < 1, so the
             # counters must distinguish a launch from an answered query.
@@ -594,6 +527,7 @@ class ShardedQueryEngine:
             fn = cache.get(sig)
             if fn is not None:
                 cache[sig] = cache.pop(sig)  # LRU touch
+                self.counters["fn_cache_hits"] += 1
             return fn
 
     def _fn_build(self, cache: Dict[Tuple, Callable], sig: Tuple,
@@ -616,6 +550,11 @@ class ShardedQueryEngine:
             try:
                 failpoints.fire("device-compile")
                 fn = build()
+                # Counted AFTER a successful build: a failing compile
+                # (breaker path) must not inflate the one-build-per-
+                # canonical-shape proof counter.
+                with self._lock:
+                    self.counters["fn_cache_builds"] += 1
             except Exception as e:
                 with self._lock:
                     self.counters["device_dispatch_errors"] += 1
@@ -1227,7 +1166,7 @@ class ShardedQueryEngine:
         pre-write count would serve stale results forever. With the probe-
         time fingerprint the entry just misses on the next probe (the safe
         direction, matching the leaf cache's fp-before-read ordering)."""
-        key = (index, tuple(comp.signature), tuple(comp.leaves), shards)
+        key = (index, comp.plan.sig_tuple, tuple(comp.leaves), shards)
         # O(1) staleness fast path: when the index's write epoch hasn't
         # moved since the entry was stored, NOTHING in the index changed,
         # so the O(U x S) per-fragment fingerprint walk below is pure
@@ -1477,7 +1416,8 @@ class ShardedQueryEngine:
     # -------------------------------------------------------------- queries
 
     def _compile(self, index: str, call: Call, field_cache: Optional[Dict] = None):
-        comp = _Compiler(self.holder, index, field_cache=field_cache)
+        comp = _Compiler(self.holder, index, field_cache=field_cache,
+                         plan_cache=self._plan_cache_enabled)
         expr = comp.compile(call)
         return comp, expr
 
@@ -1500,7 +1440,7 @@ class ShardedQueryEngine:
                 self.counters["host_cold_counts"] += 1
             self.memo_store(token, result)
             return result
-        hsig = tuple(comp.signature)
+        hsig = comp.plan.sig_tuple
         sig = ("count", hsig, len(shards))
 
         def build():
@@ -1529,7 +1469,7 @@ class ShardedQueryEngine:
         second AST walk."""
         shards = tuple(shards)
         comp, expr = comp_expr if comp_expr is not None else self._compile(index, call)
-        hsig = tuple(comp.signature)
+        hsig = comp.plan.sig_tuple
         sig = ("count", hsig, len(shards))
 
         def build():
@@ -1623,7 +1563,7 @@ class ShardedQueryEngine:
         for comp, _ in comps[1:]:
             if comp.signature != sig0_list:
                 raise QueryError("count_batch requires structurally identical queries")
-        sig0 = tuple(sig0_list)
+        sig0 = comps[0][0].plan.sig_tuple
 
         # Set-op trees (Row/Intersect/Union/Difference/Xor) are elementwise,
         # so the whole batch vectorizes: dedupe the batch's leaf rows into one
@@ -1631,9 +1571,9 @@ class ShardedQueryEngine:
         # index per leaf position. One small take+logic+popcount program, one
         # dispatch, one (Q,) transfer — and because the row choice is an
         # *input* (not baked into the trace), every batch of the same shape
-        # reuses the compiled program.
-        set_ops = {"row", "Intersect", "Union", "Difference", "Xor"}
-        if all(entry[0] in set_ops for entry in sig0):
+        # reuses the compiled program. The canonical plan carries the gate
+        # (setops_only) precomputed.
+        if comps[0][0].plan is not None and comps[0][0].plan.setops_only:
             return self._count_batch_setops(index, comps, shards, len(calls))
 
         sig = ("count_batch", sig0, len(shards), len(calls))
@@ -1658,22 +1598,27 @@ class ShardedQueryEngine:
         self._count_dispatch()
         return self._device_call(sig0, lambda: fn(leavess))
 
-    def _count_batch_setops(self, index: str, comps, shards: Tuple[int, ...],
-                            q: int) -> jax.Array:
-        """Returns the unmaterialized (Qp,) device counts, Qp ≥ q."""
+    @staticmethod
+    def _batch_slot_gather(comps, q: int):
+        """THE batch-assembly prologue shared by the fused batched count
+        and bitmap programs: leaf-slot dict, per-leaf-position (Q,) slot
+        vectors, within-batch dedup — structurally identical queries over
+        the same leaf slots compute ONCE and fan back out via `inverse`
+        (real serving mixes repeat hot queries heavily, zipf) — and
+        power-of-two padding so varying batch sizes hit a handful of
+        compiled programs. One implementation so the two batched paths
+        cannot drift on dedup/pad semantics. Returns
+        (slots, idxs, inverse, q_deduped, qp)."""
         slots: Dict[Leaf, int] = {}
         for comp, _ in comps:
             for leaf in comp.leaves:
                 slots.setdefault(leaf, len(slots))
         n_pos = len(comps[0][0].leaves)
         idxs = tuple(
-            np.array([slots[comp.leaves[j]] for comp, _ in comps], dtype=np.int32)
+            np.array([slots[comp.leaves[j]] for comp, _ in comps],
+                     dtype=np.int32)
             for j in range(n_pos)
         )
-        # Within-batch memoization: structurally identical queries over the
-        # same leaf slots are computed once and fanned back out with a
-        # device-side take (stays async). Real serving mixes repeat hot
-        # queries heavily (zipf), so this is a big win at no accuracy cost.
         inverse = None
         if q > 1:
             mat = np.stack(idxs)  # (L, Q)
@@ -1682,12 +1627,17 @@ class ShardedQueryEngine:
                 idxs = tuple(np.ascontiguousarray(row) for row in uniq)
                 inverse = inv.reshape(-1).astype(np.int32)
                 q = uniq.shape[1]
-        # Pad batch size to a power of two so varying batch sizes hit a
-        # handful of compiled programs instead of one each.
         qp = 1 << (q - 1).bit_length()
         if qp != q:
-            idxs = tuple(np.concatenate([ix, np.full(qp - q, ix[-1], np.int32)])
-                         for ix in idxs)
+            idxs = tuple(
+                np.concatenate([ix, np.full(qp - q, ix[-1], np.int32)])
+                for ix in idxs)
+        return slots, idxs, inverse, q, qp
+
+    def _count_batch_setops(self, index: str, comps, shards: Tuple[int, ...],
+                            q: int) -> jax.Array:
+        """Returns the unmaterialized (Qp,) device counts, Qp ≥ q."""
+        slots, idxs, inverse, q, qp = self._batch_slot_gather(comps, q)
         stacked = self._stacked_leaf_tensor(index, list(slots), shards,
                                             pad_pow2=True)
         up = stacked.shape[0]
@@ -1705,7 +1655,7 @@ class ShardedQueryEngine:
 
         # sig0 is row-independent for set-op trees (Row entries carry leaf
         # positions, not row ids), so one compiled program serves any rows.
-        sig = ("count_batch_setops", tuple(comps[0][0].signature),
+        sig = ("count_batch_setops", comps[0][0].plan.sig_tuple,
                len(shards), qp, up, invp)
         def build():
             expr = comps[0][1]
@@ -1770,7 +1720,7 @@ class ShardedQueryEngine:
                     return counts_of(stacked, idxs)
             return fn
 
-        hsig = tuple(comps[0][0].signature)
+        hsig = comps[0][0].plan.sig_tuple
         fn = self._fn_build(self._count_fns, sig, build, health_sig=hsig)
         self._count_dispatch()
         if inv_in is not None:
@@ -1800,7 +1750,7 @@ class ShardedQueryEngine:
         segments stay on device (one (W,) plane per shard)."""
         shards = tuple(shards)
         comp, expr = comp_expr if comp_expr is not None else self._compile(index, call)
-        hsig = tuple(comp.signature)
+        hsig = comp.plan.sig_tuple
         sig = ("bitmap", hsig, len(shards))
         fn = self._fn_build(self._bitmap_fns, sig, lambda: jax.jit(expr),
                             health_sig=hsig)
@@ -1814,6 +1764,65 @@ class ShardedQueryEngine:
         planes = self._device_call(
             hsig, lambda: fn(leaves).block_until_ready())  # (S_padded, W)
         return Row({shard: planes[i] for i, shard in enumerate(shards)})
+
+    def bitmap_batch(self, index: str, calls: Sequence[Call],
+                     shards: Sequence[int], comps=None) -> List[Row]:
+        """Evaluate Q same-signature bitmap call trees in ONE device
+        program — the micro-batcher's generalized launch for bitmap
+        (Row/set-op tree) dispatches, mirroring count_batch. The batch
+        vectorizes exactly like _count_batch_setops: dedupe the batch's
+        leaf rows into one stacked (U, S, W) tensor and gather each
+        query's leaves with a (Q,) slot vector per leaf position, so one
+        take+logic program produces all Q result planes and every batch
+        of the same canonical shape reuses the compiled program. Trees
+        outside the slot-gather shapes (BSI, time ranges) serve per-call
+        — identical to the unbatched path."""
+        shards = tuple(shards)
+        if comps is None:
+            fcache: Dict = {}
+            comps = [self._compile(index, c, field_cache=fcache) for c in calls]
+        plan0 = comps[0][0].plan
+        if len(calls) == 1 or plan0 is None or not plan0.setops_only:
+            return [self.bitmap(index, c, shards, comp_expr=ce)
+                    for c, ce in zip(calls, comps)]
+        sig0_list = comps[0][0].signature
+        for comp, _ in comps[1:]:
+            if comp.signature != sig0_list:
+                raise QueryError(
+                    "bitmap_batch requires structurally identical queries")
+        # Shared prologue with the count path: slot vectors, within-batch
+        # dedup (identical queries compute ONE plane; their Rows share
+        # the immutable device array), power-of-two padding.
+        n_calls = len(calls)
+        slots, idxs, inverse, _, qp = self._batch_slot_gather(comps, n_calls)
+        stacked = self._stacked_leaf_tensor(index, list(slots), shards,
+                                            pad_pow2=True)
+        up = stacked.shape[0]
+        hsig = comps[0][0].plan.sig_tuple
+        sig = ("bitmap_batch", hsig, len(shards), qp, up)
+        expr = comps[0][1]
+
+        def build():
+            @jax.jit
+            def fn(stacked, idxs):
+                leaves = tuple(stacked[ix] for ix in idxs)  # each (Qp, S, W)
+                return expr(leaves)
+
+            return fn
+
+        fn = self._fn_build(self._bitmap_fns, sig, build, health_sig=hsig)
+        with self._lock:
+            self.counters["bitmap_dispatches"] += 1
+        # block_until_ready inside the guard, like bitmap(): an async
+        # device fault must classify here, not inside a later Row op.
+        planes = self._device_call(
+            hsig,
+            lambda: fn(stacked, idxs).block_until_ready())  # (Qp, Sp, W)
+        return [
+            Row({shard: planes[qi if inverse is None else int(inverse[qi]), i]
+                 for i, shard in enumerate(shards)})
+            for qi in range(n_calls)
+        ]
 
     def topn_shard_counts(
         self, index: str, field: str, row_ids: Sequence[int],

@@ -1,24 +1,30 @@
 """Cross-query micro-batcher: coalesce compatible device dispatches.
 
-The engine can already count Q structurally-identical queries in ONE
-device program (parallel/engine.py count_batch) — but only a single
-caller ever used it. Under concurrent serving, N independent HTTP
-threads each launched their own program over the SAME resident leaf
-stack, paying N dispatches and N host<->device round trips for work one
-fused (U, S, W) pass amortizes (the kernels are HBM-bandwidth-bound, so
-the memory traffic dominates).
+The engine can evaluate Q same-signature expressions in ONE device
+program (parallel/engine.py count_batch / bitmap_batch) — but only a
+single caller ever used it. Under concurrent serving, N independent
+HTTP threads each launched their own program over the SAME resident
+leaf stack, paying N dispatches and N host<->device round trips for
+work one fused (U, S, W) pass amortizes (the kernels are HBM-bandwidth-
+bound, so the memory traffic dominates).
 
-This batcher holds a count dispatch for a short window and coalesces
-every compatible request that arrives meanwhile:
+This batcher holds a device dispatch for a short window and coalesces
+every compatible request that arrives meanwhile. Originally it coalesced
+only identical-shape Counts; it now batches ARBITRARY same-signature
+expressions (docs/query-compiler.md): the compatibility key's signature
+is the CANONICAL plan signature, so commutative/associative respellings
+of one query shape land in one group, and bitmap (Row/set-op tree)
+dispatches batch alongside counts through the same machinery:
 
-  - compatibility key: (index, shard set, structure signature, index
-    write epoch) — same leaf stack, same compiled program shape, same
-    stack generation, so the fused launch is byte-identical to running
-    each query alone at that instant;
+  - compatibility key: (kind, index, shard set, canonical structure
+    signature, index write epoch) — same leaf stack, same compiled
+    program shape, same stack generation, so the fused launch is
+    byte-identical to running each query alone at that instant;
   - the FIRST arrival becomes the group leader: it waits the window,
-    then takes the group and runs one engine.count_batch launch,
-    splitting the (Q,) result back per caller; followers just wait on
-    their slot;
+    then takes the group and runs one fused engine launch
+    (count_batch for kind=count, bitmap_batch for kind=bitmap),
+    splitting the per-query results back to the callers; followers just
+    wait on their slot;
   - the window adapts to load: with <= 1 query in flight there is nobody
     to coalesce with, so the dispatch goes out immediately (zero added
     latency for a lone client); under concurrency it grows with queue
@@ -112,13 +118,32 @@ class MicroBatcher:
         deterministic tests."""
         group.full.wait(timeout=window)
 
-    # -------------------------------------------------------------- count
+    # ------------------------------------------------------------ submit
 
     def count(self, index: str, call, shards, comp_expr=None,
               deadline: Optional[Deadline] = None) -> int:
         """Count(call) over `shards`, coalesced with any compatible
         concurrent request. Results are byte-identical to the unbatched
         engine path (count_batch shares the memo and the count program)."""
+        return self._submit("count", index, call, shards, comp_expr, deadline)
+
+    def bitmap(self, index: str, call, shards, comp_expr=None,
+               deadline: Optional[Deadline] = None):
+        """Evaluate a bitmap call tree over `shards` as a Row, coalesced
+        with compatible concurrent bitmap requests into one fused
+        bitmap_batch launch — the batcher generalization beyond Counts
+        (docs/query-compiler.md). Same-window, same-key machinery as
+        count(); results are byte-identical to engine.bitmap."""
+        return self._submit("bitmap", index, call, shards, comp_expr,
+                            deadline)
+
+    def _direct(self, kind: str, engine, index: str, call, shards, comp_expr):
+        if kind == "count":
+            return engine.count(index, call, shards, comp_expr=comp_expr)
+        return engine.bitmap(index, call, shards, comp_expr=comp_expr)
+
+    def _submit(self, kind: str, index: str, call, shards, comp_expr,
+                deadline: Optional[Deadline]):
         engine = self.get_engine()
         window = self.effective_window()
         if window <= 0:
@@ -126,20 +151,33 @@ class MicroBatcher:
             # micro-batcher stage (held=0 means "nobody to coalesce
             # with, dispatched immediately").
             obs_record("batch.hold", 0.0, held=0)
-            return engine.count(index, call, shards, comp_expr=comp_expr)
+            return self._direct(kind, engine, index, call, shards, comp_expr)
         if comp_expr is None or comp_expr is True:
             comp_expr = engine._compile(index, call)
         comp, _ = comp_expr
         shards = tuple(shards)
-        # Memo hits answer NOW: a repeat hot query is a dict lookup, and
-        # parking it in a window group would turn microseconds into
-        # milliseconds under concurrency. Only memo misses — the queries
-        # that actually need a device launch — are worth coalescing.
-        hit, _ = engine.memo_probe(index, comp, shards)
-        if hit is not None:
-            return hit
+        if kind == "bitmap" and (comp.plan is None
+                                 or not comp.plan.setops_only):
+            # Non-slot-gather shapes (BSI / time-range trees) can only be
+            # served per-call by bitmap_batch anyway: holding them in a
+            # window group would add latency and serialize them behind
+            # one leader for zero coalescing benefit. Dispatch direct.
+            obs_record("batch.hold", 0.0, held=0)
+            return self._direct(kind, engine, index, call, shards, comp_expr)
+        if kind == "count":
+            # Memo hits answer NOW: a repeat hot query is a dict lookup,
+            # and parking it in a window group would turn microseconds
+            # into milliseconds under concurrency. Only memo misses — the
+            # queries that actually need a device launch — are worth
+            # coalescing. (Bitmap results have no memo: the values are
+            # whole planes.)
+            hit, _ = engine.memo_probe(index, comp, shards)
+            if hit is not None:
+                return hit
         key = (
-            index, shards, tuple(comp.signature),
+            kind, index, shards,
+            comp.plan.sig_tuple if comp.plan is not None
+            else tuple(comp.signature),
             engine.stack_generation(index),
         )
         item = _Item(call, comp_expr)
@@ -162,7 +200,7 @@ class MicroBatcher:
         if leader:
             with obs_span("batch.hold", role="leader", held=1):
                 self.wait_window(group, window)
-            self._run(key, group, engine, index, shards)
+            self._run(kind, key, group, engine, index, shards)
         else:
             # Leader wedged (device hang) or deadline pressure: fall back
             # to a direct dispatch rather than parking forever. The bound
@@ -179,13 +217,14 @@ class MicroBatcher:
                     self.counters["fallbacks"] += 1
                 if deadline is not None:
                     deadline.check("micro-batch wait")
-                return engine.count(index, call, shards,
-                                    comp_expr=item.comp_expr)
+                return self._direct(kind, engine, index, call, shards,
+                                    item.comp_expr)
         if item.error is not None:
             raise item.error
         return item.result
 
-    def _run(self, key, group: _Group, engine, index: str, shards) -> None:
+    def _run(self, kind: str, key, group: _Group, engine, index: str,
+             shards) -> None:
         with self._lock:
             if self._pending.get(key) is group:
                 del self._pending[key]
@@ -193,15 +232,20 @@ class MicroBatcher:
             items = list(group.items)
         try:
             if len(items) == 1:
-                results = [engine.count(index, items[0].call, shards,
-                                        comp_expr=items[0].comp_expr)]
-            else:
+                results = [self._direct(kind, engine, index, items[0].call,
+                                        shards, items[0].comp_expr)]
+            elif kind == "count":
                 results = engine.count_batch(
                     index, [it.call for it in items], shards,
                     comps=[it.comp_expr for it in items],
                 )
+            else:
+                results = engine.bitmap_batch(
+                    index, [it.call for it in items], shards,
+                    comps=[it.comp_expr for it in items],
+                )
             for it, r in zip(items, results):
-                it.result = int(r)
+                it.result = int(r) if kind == "count" else r
         except BaseException as e:
             for it in items:
                 it.error = e

@@ -701,6 +701,16 @@ class Handler:
             # during a device incident is "is the plane breaker open, and
             # are queries being answered from the host ladder or erroring".
             out["device_plane"] = engine.device_health.snapshot()
+        # Query-plan compiler health (docs/query-compiler.md):
+        # canonical lowerings vs on-Call cache hits plus the
+        # canonicalization effect counters (reorders / k-ary flattens).
+        # Module-level (the plan compiler serves every engine in the
+        # process), so the group is present even before the lazy engine
+        # initializes.
+        from ..plan import snapshot as _plan_snapshot
+
+        out = dict(out)
+        out["plan"] = _plan_snapshot()
         # Scheduler lifecycle metrics: queue depth, admit/shed/deadline
         # counts, and the micro-batcher's launch/coalesce counters (wait
         # time and batch-size histograms live in the stats timings above).

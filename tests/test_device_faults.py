@@ -565,12 +565,26 @@ class TestExecutorLadder:
     def test_count_served_by_host_ladder_under_fault(self, holder):
         ex = self._executor(holder)
         try:
-            healthy = ex.execute("i", "Count(Intersect(Row(f=0),Row(f=1)))")[0]
+            healthy = ex.execute("i", "Count(Intersect(Row(f=1),Row(f=2)))")[0]
             failpoints.configure("device-dispatch", "error")
-            # Fresh structure so the memo can't answer it.
-            got = ex.execute("i", "Count(Intersect(Row(f=1),Row(f=0)))")[0]
-            healthy2 = ex.execute("i", "Count(Intersect(Row(f=0),Row(f=1)))")[0]
+            # A commutative respelling now canonicalizes onto the same
+            # memo entry (docs/query-compiler.md) and must still answer.
+            got = ex.execute("i", "Count(Intersect(Row(f=2),Row(f=1)))")[0]
+            # A fresh leaf SET busts the memo, so THIS query exercises
+            # the faulted dispatch + host-ladder value path.
+            fresh = ex.execute("i", "Count(Intersect(Row(f=0),Row(f=1)))")[0]
+            healthy2 = ex.execute("i", "Count(Intersect(Row(f=1),Row(f=2)))")[0]
             assert got == healthy == healthy2
+            failpoints.reset()
+            # Value-check the ladder-served answer against the healthy
+            # DEVICE path for the same query — a set+clear bumps the
+            # generation so the re-execution cannot be a memo read of
+            # the host ladder's own stored value.
+            fld = holder.index("i").field("f")
+            fld.set_bit(0, 8000)
+            fld.clear_bit(0, 8000)
+            assert fresh == ex.execute(
+                "i", "Count(Intersect(Row(f=0),Row(f=1)))")[0]
             assert ex.engine.counters["host_counts"] >= 1
         finally:
             failpoints.reset()

@@ -186,6 +186,7 @@ JAX_FREE_ZONES = (
     "pilosa_tpu/parallel/__init__.py",
     "pilosa_tpu/sched/",
     "pilosa_tpu/obs/",
+    "pilosa_tpu/plan/",
 )
 
 
