@@ -2446,6 +2446,331 @@ def bench_compile():
     return out
 
 
+# ------------------------------------------- multi-chip collective stanza
+
+_MULTICHIP_CHILD = r'''
+import json, os, re, sys, threading, time
+
+# The collective plane's acceptance mesh is 8 CPU devices (MULTICHIP_r05
+# dry-run shape): replace any inherited device-count flag — duplicates
+# are ambiguous.
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Memos off on BOTH paths: the comparison is the steady-state DISPATCH
+# cost (resident-stack fused collective vs per-node fan-out), and a memo
+# hit dispatches nothing (same rationale as the DEGRADE/COMPILE stanzas).
+os.environ["PILOSA_MEMO_ENTRIES"] = "0"
+
+import numpy as np
+
+from pilosa_tpu import failpoints
+from pilosa_tpu.cluster.hash import ModHasher
+from pilosa_tpu.cluster.health import ResilienceConfig
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.parallel import CollectiveConfig, EngineConfig
+from pilosa_tpu.sched import SchedulerConfig
+from pilosa_tpu.server.client import InternalClient
+from pilosa_tpu.server.server import Server
+
+# Per-node engines pinned to ONE device: concurrent sharded programs
+# whose reductions lower to cross-device all-reduces can interleave
+# their rendezvous on the multi-device CPU backend and deadlock
+# (observed here as two stuck 8-way rendezvous holding every device
+# thread hostage). With mesh-devices=1 per-node programs carry no
+# collectives at all; ONLY the collective plane — whose entries the
+# runner serializes — uses the 8-device mesh. This is also the fan-out
+# side's fastest CPU configuration (no pointless 8-way reduce of
+# 2-shard data), so the comparison is against its best self.
+ENGINE_ONE_DEVICE = EngineConfig(mesh_devices=1)
+
+import socket
+import tempfile
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+n_shards = int(sys.argv[1])
+n_rows = int(sys.argv[2])
+clients = int(sys.argv[3])
+per_client = int(sys.argv[4])
+
+tmp = tempfile.mkdtemp(prefix="bench-multichip-")
+out = {"shards": n_shards, "rows": n_rows, "clients": clients,
+       "queries_per_client": per_client}
+
+# Deterministic data, identical on both clusters.
+rng = np.random.default_rng(12)
+rows_cols = {}
+for row in range(n_rows):
+    cols = []
+    for s in range(n_shards):
+        local = sorted(int(c) for c in rng.choice(2048, size=24, replace=False))
+        cols.extend(s * SHARD_WIDTH + c for c in local)
+    rows_cols[row] = set(cols)
+
+pairs = [(a, b) for a in range(n_rows) for b in range(n_rows) if a != b]
+queries = [f"Count(Intersect(Row(f={a}), Row(f={b})))" for a, b in pairs]
+expected = [len(rows_cols[a] & rows_cols[b]) for a, b in pairs]
+
+# Generous per-request timeout: the smoke child shares a loaded box
+# with the rest of the tier-1 suite (a 15s timeout flaked there), and
+# compile-heavy warmup happens via DIRECT executor/backend calls below
+# so no HTTP request ever waits on a first-touch jit compile.
+client = InternalClient(timeout=120.0)
+
+
+def import_data(host):
+    client.create_index(host, "mc")
+    client.create_field(host, "mc", "f")
+    for row, cols in rows_cols.items():
+        # One batched import per row rides the normal cluster write path
+        # (jump-hash placement on the fan-out cluster).
+        client.import_bits(host, "mc", "f", [(row, c) for c in sorted(cols)])
+
+
+def run_concurrent(host, qs):
+    """C client threads, each issuing its slice of `qs`; returns
+    (qps, answers-in-order, errors)."""
+    answers = [None] * len(qs)
+    errors = [0]
+    lock = threading.Lock()
+    idx = [0]
+
+    def worker():
+        while True:
+            with lock:
+                i = idx[0]
+                if i >= len(qs):
+                    return
+                idx[0] += 1
+            try:
+                got = client.query(host, "mc", qs[i])
+                answers[i] = int(got["results"][0])
+            except Exception:
+                with lock:
+                    errors[0] += 1
+
+    threads = [threading.Thread(target=worker) for _ in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    return round(len(qs) / dt, 1), answers, errors[0]
+
+
+workload = [queries[i % len(queries)] for i in range(clients * per_client)]
+want = [expected[i % len(queries)] for i in range(clients * per_client)]
+
+# ---- HTTP fan-out cluster: 2 nodes, shards split by placement, the
+# reference-style scatter-gather path the collective plane replaces.
+ports = [free_port(), free_port()]
+hosts = [f"localhost:{p}" for p in ports]
+fan_servers = []
+for i, port in enumerate(ports):
+    s = Server(
+        data_dir=os.path.join(tmp, f"fan{i}"), port=port,
+        cluster_hosts=hosts, replica_n=1, hasher=ModHasher(),
+        cache_flush_interval=0, anti_entropy_interval=0,
+        member_monitor_interval=0,
+        engine_config=ENGINE_ONE_DEVICE,
+    )
+    s.open()
+    fan_servers.append(s)
+import_data(hosts[0])
+# Remote shards must exist, or "fan-out" measures a single node.
+head = fan_servers[0]
+remote_shards = [s for s in range(n_shards)
+                 if all(n.id != head.node.id
+                        for n in head.cluster.shard_nodes("mc", s))]
+out["fanout_remote_shards"] = len(remote_shards)
+
+# Warmup + correctness reference. Compiles happen via direct executor
+# calls first (each node's engine), socket-free; the HTTP loop then
+# establishes the reference answers without first-touch compile stalls.
+from pilosa_tpu.pql.parser import parse
+for s in fan_servers:
+    for q in queries:
+        s.executor.execute("mc", q)
+fan_answers = [int(client.query(hosts[0], "mc", q)["results"][0])
+               for q in queries]
+_, wa, werr = run_concurrent(hosts[0], workload[: clients * 2])
+fan_qps, fan_conc, fan_err = run_concurrent(hosts[0], workload)
+out["fanout"] = {"qps": fan_qps, "errors": fan_err}
+
+# ---- collective pod: one process, one node, all shards local, the
+# 8-device mesh serving whole-index Counts as ONE fused SPMD program per
+# micro-batch (resident sharded stacks + batched launches).
+pod_port = free_port()
+pod_host = f"localhost:{pod_port}"
+pod = Server(
+    data_dir=os.path.join(tmp, "pod"), port=pod_port,
+    cluster_hosts=[pod_host], replica_n=1,
+    cache_flush_interval=0, anti_entropy_interval=0,
+    member_monitor_interval=0,
+    # The pod's PER-NODE engine (the chaos leg's fallback rung) is also
+    # one-device; the collective plane's global mesh stays 8-wide.
+    engine_config=ENGINE_ONE_DEVICE,
+    collective_config=CollectiveConfig(single_process=1),
+    resilience_config=ResilienceConfig(
+        collective_breaker_failures=2, collective_breaker_backoff=0.2,
+        collective_breaker_backoff_max=1.0),
+    scheduler_config=SchedulerConfig(batch_max=8),
+)
+pod.open()
+import_data(pod_host)
+assert pod.collective.active(), "collective plane inactive on the pod"
+
+# Warm every compiled shape DIRECTLY (no sockets): each unique query's
+# resident leaves + the pow2 batch programs (1/2/4) the micro-batcher
+# can launch, plus the fan-out fallback path the chaos leg will take.
+calls = [parse(q).calls[0].children[0] for q in queries]
+for c in calls:
+    pod.collective.count("mc", c)
+for n in (2, 4, 8):
+    pod.collective.count_batch("mc", (calls * 2)[:n])
+pod.executor.engine.count("mc", calls[0], list(range(n_shards)))
+coll_answers = [int(client.query(pod_host, "mc", q)["results"][0])
+                for q in queries]
+_, _, _ = run_concurrent(pod_host, workload[: clients * 2])
+
+coll_qps, coll_conc, coll_err = run_concurrent(pod_host, workload)
+snap = pod.collective.snapshot()
+out["collective"] = {
+    "qps": coll_qps, "errors": coll_err,
+    "served_count": snap["served_count"],
+    "batched_entries": snap["batched_entries"],
+    "batched_launches": snap["batched_launches"],
+    "resident_hits": snap["resident_hits"],
+    "full_refreshes": snap["full_refreshes"],
+    "fallbacks": snap["fallbacks"],
+}
+out["collective_vs_fanout"] = round(coll_qps / max(fan_qps, 1e-9), 2)
+# Bit-exactness NEVER retried: both paths must equal the host-computed
+# reference, warm and under concurrency.
+out["bit_exact"] = bool(
+    fan_answers == expected == coll_answers
+    and fan_conc == want and coll_conc == want
+    and fan_err == 0 and coll_err == 0)
+# The fast path must actually have served (a silent fallback would make
+# the ratio meaningless).
+out["collective_served"] = snap["served_count"] > len(queries)
+
+# ---- per-device-count scaling curve: the SAME fused collective count
+# program over meshes of 1/2/4/8 devices (direct backend loop — no HTTP,
+# so the curve isolates the SPMD program itself).
+import jax
+curve = {}
+loops = max(per_client, 8)
+for d in (1, 2, 4, 8):
+    if d > len(jax.devices()):
+        continue
+    pod.collective.mesh_devices = d
+    q = calls[0]
+    assert pod.collective.count("mc", q) == expected[0]  # warm + verify
+    t0 = time.perf_counter()
+    for _ in range(loops):
+        pod.collective.count("mc", q)
+    curve[str(d)] = round(loops / (time.perf_counter() - t0), 1)
+pod.collective.mesh_devices = None
+out["scaling_qps_by_devices"] = curve
+
+# ---- chaos leg: barrier timeouts. Every entry fails at the barrier;
+# the plane breaker opens after 2 and queries fall back to the fan-out
+# rung INSTANTLY (no per-query barrier wait), bit-exact throughout; when
+# the fault clears, a half-open probe re-closes the plane and the fast
+# path resumes.
+failpoints.configure("collective-barrier", "error")
+chaos_qps, chaos_answers, chaos_err = run_concurrent(pod_host, workload)
+chaos_snap = pod.collective.snapshot()
+failpoints.reset()
+served_before_recovery = pod.collective.counters["served_count"]
+recovered = False
+t0 = time.perf_counter()
+while time.perf_counter() - t0 < 20.0 and not recovered:
+    got = int(client.query(pod_host, "mc", queries[0])["results"][0])
+    assert got == expected[0]
+    recovered = (
+        pod.collective.counters["served_count"] > served_before_recovery
+        and pod.collective.health.plane_state() == "closed")
+    if not recovered:
+        time.sleep(0.05)
+out["chaos"] = {
+    "qps_during_fault": chaos_qps,
+    "errors": chaos_err,
+    "wrong_answers": sum(1 for a, w in zip(chaos_answers, want) if a != w),
+    "barrier_timeouts": chaos_snap["barrier_timeouts"],
+    "plane_opened": chaos_snap["health"]["plane_opened"],
+    "breaker_short_circuits": chaos_snap["breaker_short_circuits"],
+    "recovered": recovered,
+    "recovery_s": round(time.perf_counter() - t0, 3),
+}
+
+for s in fan_servers + [pod]:
+    try:
+        s.close()
+    except Exception as e:
+        print(f"close: {e}", file=sys.stderr)
+
+print("MULTICHIP_JSON " + json.dumps(out), flush=True)
+'''
+
+
+def bench_multichip():
+    """The collective plane as the primary read path (docs/multichip.md):
+    a child process with an 8-device CPU mesh serves the SAME whole-index
+    Count workload two ways — a 2-node HTTP fan-out cluster (the
+    reference scatter-gather path) vs a one-pod collective plane
+    (resident sharded stacks + micro-batched SPMD launches) — and
+    reports qps for both, bit-exactness of every answer against a
+    host-computed reference, a per-device-count scaling curve of the
+    fused collective program, and a barrier-timeout chaos leg proving
+    clean instant fallback (breaker open, zero wrong answers) and
+    post-fault re-close. Child process so the device count is pinned
+    regardless of how the parent's backend was brought up."""
+    import tempfile
+
+    # Concurrency is the point of the comparison: the collective side
+    # amortizes ONE barrier + ONE SPMD program across each coalesced
+    # batch, while the fan-out pays a per-query HTTP hop that nothing
+    # coalesces.
+    n_shards, n_rows = (2, 4) if SMOKE else (8, 8)
+    clients, per_client = (8, 8) if SMOKE else (8, 50)
+    script = os.path.join(tempfile.mkdtemp(prefix="bench-mc-"), "child.py")
+    with open(script, "w") as f:
+        f.write(_MULTICHIP_CHILD)
+    env = dict(os.environ)
+    # The child pins its own platform/devices; drop any forced platform
+    # so a TPU parent doesn't fight the CPU mesh pin.
+    env.pop("BENCH_FORCE_PLATFORM", None)
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, script,
+         str(n_shards), str(n_rows), str(clients), str(per_client)],
+        capture_output=True, text=True, timeout=240 if SMOKE else 1200,
+        env=env,
+    )
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"multichip child rc={r.returncode}: {r.stderr[-800:]}")
+    for line in reversed(r.stdout.strip().splitlines()):
+        if line.startswith("MULTICHIP_JSON "):
+            return json.loads(line[len("MULTICHIP_JSON "):])
+    raise RuntimeError(
+        f"multichip child produced no result line: {r.stdout[-500:]}")
+
+
 # Every optional stanza, in run order. THE registry: main() runs exactly
 # these, the FINAL JSON line carries a key per entry (lowercased), and
 # tests/test_bench_smoke.py asserts every name is present — a stanza
@@ -2467,6 +2792,7 @@ STANZAS = (
     ("DEGRADE", bench_degrade),
     ("REBALANCE", bench_rebalance),
     ("TIER", bench_tier),
+    ("MULTICHIP", bench_multichip),
     ("TOPN_BSI", bench_topn_bsi),
     ("TIME_RANGE", bench_time_range),
 )

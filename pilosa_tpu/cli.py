@@ -100,6 +100,19 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                         "per-shard path")
     p.add_argument("--resilience-device-sig-backoff",
                    dest="resilience_device_sig_backoff", type=float)
+    p.add_argument("--resilience-collective-breaker-failures",
+                   dest="resilience_collective_breaker_failures", type=int,
+                   help="consecutive collective failures (barrier timeouts, "
+                        "broadcast losses) before the collective plane stops "
+                        "being offered queries")
+    p.add_argument("--resilience-collective-breaker-backoff",
+                   dest="resilience_collective_breaker_backoff", type=float,
+                   help="initial open->half-open backoff in seconds for the "
+                        "collective plane/slice breakers (doubles per "
+                        "failed probe)")
+    p.add_argument("--resilience-collective-breaker-backoff-max",
+                   dest="resilience_collective_breaker_backoff_max",
+                   type=float)
     p.add_argument("--rebalance-online", dest="rebalance_online",
                    type=lambda s: s.lower() in ("1", "true", "yes"),
                    metavar="{true,false}",
@@ -184,6 +197,13 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    dest="engine_delta_journal_ops", type=int,
                    help="per-fragment dirty-word journal bound; overflow "
                         "falls back to full cache regathers")
+    p.add_argument("--engine-mesh-devices", dest="engine_mesh_devices",
+                   type=int,
+                   help="restrict the per-node engine mesh to the first N "
+                        "local devices (0 = all); CPU deployments serving "
+                        "through the collective plane pin this to 1 so "
+                        "per-node programs carry no cross-device "
+                        "all-reduces (docs/multichip.md)")
     p.add_argument("--engine-gather-workers", dest="engine_gather_workers",
                    type=int,
                    help="threads for cold-path per-shard plane gathers "
@@ -220,6 +240,28 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    help="1 caches each query tree's canonical plan "
                         "(signature + lowering) on the Call, keyed by the "
                         "index write epoch; 0 recompiles per dispatch site")
+    p.add_argument("--collective-enabled",
+                   dest="collective_enabled", type=int, metavar="{0,1}",
+                   help="0 turns the multi-chip collective serving plane "
+                        "off; every full-index query takes the HTTP fan-out")
+    p.add_argument("--collective-single-process",
+                   dest="collective_single_process", type=int,
+                   metavar="{0,1}",
+                   help="1 lets a single-process, single-node deployment "
+                        "serve whole-index queries through the collective "
+                        "plane over its local device mesh")
+    p.add_argument("--collective-timeout-ms",
+                   dest="collective_timeout_ms", type=int,
+                   help="collective barrier timeout in milliseconds")
+    p.add_argument("--collective-leaf-budget-bytes",
+                   dest="collective_leaf_budget_bytes", type=int,
+                   help="resident sharded-stack budget per process; "
+                        "LRU-evicted planes demote through the tier manager")
+    p.add_argument("--collective-delta-max-fraction",
+                   dest="collective_delta_max_fraction", type=float,
+                   help="dirty-word budget for delta-refreshing a stale "
+                        "resident collective plane (fraction of the tensor; "
+                        "0 disables deltas)")
     p.add_argument("--tier-hbm-bytes", dest="tier_hbm_bytes", type=int,
                    help="combined device-cache budget split across the "
                         "leaf/stack caches (0 = platform default)")

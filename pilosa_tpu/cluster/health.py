@@ -87,6 +87,17 @@ class ResilienceConfig:
     # that signature alone is quarantined to the per-shard XLA walk.
     device_sig_failures: int = 2
     device_sig_backoff: float = 10.0
+    # Collective-plane breakers (parallel/device_health.py
+    # CollectivePlaneHealth, docs/multichip.md): consecutive collective
+    # failures — barrier timeouts, descriptor-broadcast losses — before
+    # the plane (or one mesh slice) stops being offered queries and
+    # full-index reads fall back to the HTTP fan-out instantly instead
+    # of waiting out a barrier per query. OPEN -> HALF_OPEN doubles from
+    # `collective-breaker-backoff` per failed probe, capped at the max;
+    # `probe_ttl` above is shared.
+    collective_breaker_failures: int = 2
+    collective_breaker_backoff: float = 2.0
+    collective_breaker_backoff_max: float = 60.0
 
     def validate(self) -> "ResilienceConfig":
         if self.breaker_failures < 1:
@@ -111,6 +122,16 @@ class ResilienceConfig:
             raise ValueError(
                 "resilience.device-breaker-backoff-max must be >= "
                 "device-breaker-backoff")
+        if self.collective_breaker_failures < 1:
+            raise ValueError(
+                "resilience.collective-breaker-failures must be >= 1")
+        if self.collective_breaker_backoff <= 0:
+            raise ValueError(
+                "resilience.collective-breaker-backoff must be > 0")
+        if self.collective_breaker_backoff_max < self.collective_breaker_backoff:
+            raise ValueError(
+                "resilience.collective-breaker-backoff-max must be >= "
+                "collective-breaker-backoff")
         return self
 
 

@@ -78,7 +78,7 @@ from .ingest import IngestConfig  # noqa: E402
 # And for [engine]: the device-cache refresh knobs live with the parallel
 # engine (pilosa_tpu/parallel/__init__.py, jax-free so CLI startup stays
 # light). See docs/engine-caches.md.
-from .parallel import EngineConfig  # noqa: E402
+from .parallel import CollectiveConfig, EngineConfig  # noqa: E402
 
 # And for [tier]: the HBM ↔ host-RAM ↔ disk residency budgets live with
 # the tier manager (pilosa_tpu/tier/, jax-free). See
@@ -139,6 +139,7 @@ class Config:
     storage: StorageConfig = field(default_factory=StorageConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
+    collective: CollectiveConfig = field(default_factory=CollectiveConfig)
     tier: TierConfig = field(default_factory=TierConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     rebalance: RebalanceConfig = field(default_factory=RebalanceConfig)
@@ -211,6 +212,15 @@ class Config:
             "device-sig-failures", self.resilience.device_sig_failures)
         self.resilience.device_sig_backoff = r.get(
             "device-sig-backoff", self.resilience.device_sig_backoff)
+        self.resilience.collective_breaker_failures = r.get(
+            "collective-breaker-failures",
+            self.resilience.collective_breaker_failures)
+        self.resilience.collective_breaker_backoff = r.get(
+            "collective-breaker-backoff",
+            self.resilience.collective_breaker_backoff)
+        self.resilience.collective_breaker_backoff_max = r.get(
+            "collective-breaker-backoff-max",
+            self.resilience.collective_breaker_backoff_max)
         rb = d.get("rebalance", {})
         self.rebalance.online = rb.get("online", self.rebalance.online)
         self.rebalance.max_concurrent_streams = rb.get(
@@ -261,6 +271,8 @@ class Config:
             "delta-journal-ops", self.engine.delta_journal_ops)
         self.engine.gather_workers = e.get(
             "gather-workers", self.engine.gather_workers)
+        self.engine.mesh_devices = e.get(
+            "mesh-devices", self.engine.mesh_devices)
         self.engine.leaf_cache_bytes = e.get(
             "leaf-cache-bytes", self.engine.leaf_cache_bytes)
         self.engine.stack_cache_bytes = e.get(
@@ -275,6 +287,16 @@ class Config:
             "cold-host-count", self.engine.cold_host_count)
         self.engine.plan_cache = e.get(
             "plan-cache", self.engine.plan_cache)
+        co = d.get("collective", {})
+        self.collective.enabled = co.get("enabled", self.collective.enabled)
+        self.collective.single_process = co.get(
+            "single-process", self.collective.single_process)
+        self.collective.timeout_ms = co.get(
+            "timeout-ms", self.collective.timeout_ms)
+        self.collective.leaf_budget_bytes = co.get(
+            "leaf-budget-bytes", self.collective.leaf_budget_bytes)
+        self.collective.delta_max_fraction = co.get(
+            "delta-max-fraction", self.collective.delta_max_fraction)
         ti = d.get("tier", {})
         self.tier.hbm_bytes = ti.get("hbm-bytes", self.tier.hbm_bytes)
         self.tier.host_bytes = ti.get("host-bytes", self.tier.host_bytes)
@@ -359,6 +381,12 @@ class Config:
              "RESILIENCE_DEVICE_BREAKER_BACKOFF_MAX", float),
             ("device_sig_failures", "RESILIENCE_DEVICE_SIG_FAILURES", int),
             ("device_sig_backoff", "RESILIENCE_DEVICE_SIG_BACKOFF", float),
+            ("collective_breaker_failures",
+             "RESILIENCE_COLLECTIVE_BREAKER_FAILURES", int),
+            ("collective_breaker_backoff",
+             "RESILIENCE_COLLECTIVE_BREAKER_BACKOFF", float),
+            ("collective_breaker_backoff_max",
+             "RESILIENCE_COLLECTIVE_BREAKER_BACKOFF_MAX", float),
         ]:
             v = env(name, cast)
             if v is not None:
@@ -413,6 +441,7 @@ class Config:
             ("delta_max_fraction", "ENGINE_DELTA_MAX_FRACTION", float),
             ("delta_journal_ops", "ENGINE_DELTA_JOURNAL_OPS", int),
             ("gather_workers", "ENGINE_GATHER_WORKERS", int),
+            ("mesh_devices", "ENGINE_MESH_DEVICES", int),
             ("leaf_cache_bytes", "ENGINE_LEAF_CACHE_BYTES", int),
             ("stack_cache_bytes", "ENGINE_STACK_CACHE_BYTES", int),
             ("memo_entries", "ENGINE_MEMO_ENTRIES", int),
@@ -424,6 +453,26 @@ class Config:
             v = env(name, cast)
             if v is not None:
                 setattr(self.engine, attr, v)
+        # Legacy collective env spellings predate the [collective]
+        # section (the backend read them directly); keep honoring them on
+        # config-resolved deployments, below the PILOSA_TPU_* spellings.
+        for attr, legacy, cast in [
+            ("timeout_ms", "PILOSA_COLLECTIVE_TIMEOUT_MS", int),
+            ("leaf_budget_bytes", "PILOSA_COLLECTIVE_LEAF_BYTES", int),
+        ]:
+            v = os.environ.get(legacy)
+            if v is not None:
+                setattr(self.collective, attr, cast(v))
+        for attr, name, cast in [
+            ("enabled", "COLLECTIVE_ENABLED", int),
+            ("single_process", "COLLECTIVE_SINGLE_PROCESS", int),
+            ("timeout_ms", "COLLECTIVE_TIMEOUT_MS", int),
+            ("leaf_budget_bytes", "COLLECTIVE_LEAF_BUDGET_BYTES", int),
+            ("delta_max_fraction", "COLLECTIVE_DELTA_MAX_FRACTION", float),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.collective, attr, v)
         for attr, name, cast in [
             ("hbm_bytes", "TIER_HBM_BYTES", int),
             ("host_bytes", "TIER_HOST_BYTES", int),
@@ -488,6 +537,12 @@ class Config:
                 ("resilience", "device_sig_failures"),
             "resilience_device_sig_backoff":
                 ("resilience", "device_sig_backoff"),
+            "resilience_collective_breaker_failures":
+                ("resilience", "collective_breaker_failures"),
+            "resilience_collective_breaker_backoff":
+                ("resilience", "collective_breaker_backoff"),
+            "resilience_collective_breaker_backoff_max":
+                ("resilience", "collective_breaker_backoff_max"),
             "rebalance_online": ("rebalance", "online"),
             "rebalance_max_concurrent_streams":
                 ("rebalance", "max_concurrent_streams"),
@@ -518,6 +573,7 @@ class Config:
             "engine_delta_max_fraction": ("engine", "delta_max_fraction"),
             "engine_delta_journal_ops": ("engine", "delta_journal_ops"),
             "engine_gather_workers": ("engine", "gather_workers"),
+            "engine_mesh_devices": ("engine", "mesh_devices"),
             "engine_leaf_cache_bytes": ("engine", "leaf_cache_bytes"),
             "engine_stack_cache_bytes": ("engine", "stack_cache_bytes"),
             "engine_memo_entries": ("engine", "memo_entries"),
@@ -525,6 +581,13 @@ class Config:
             "engine_dispatch_watchdog": ("engine", "dispatch_watchdog"),
             "engine_cold_host_count": ("engine", "cold_host_count"),
             "engine_plan_cache": ("engine", "plan_cache"),
+            "collective_enabled": ("collective", "enabled"),
+            "collective_single_process": ("collective", "single_process"),
+            "collective_timeout_ms": ("collective", "timeout_ms"),
+            "collective_leaf_budget_bytes":
+                ("collective", "leaf_budget_bytes"),
+            "collective_delta_max_fraction":
+                ("collective", "delta_max_fraction"),
             "tier_hbm_bytes": ("tier", "hbm_bytes"),
             "tier_host_bytes": ("tier", "host_bytes"),
             "tier_disk_bytes": ("tier", "disk_bytes"),
@@ -596,6 +659,9 @@ class Config:
             f"device-breaker-backoff-max = {self.resilience.device_breaker_backoff_max}",
             f"device-sig-failures = {self.resilience.device_sig_failures}",
             f"device-sig-backoff = {self.resilience.device_sig_backoff}",
+            f"collective-breaker-failures = {self.resilience.collective_breaker_failures}",
+            f"collective-breaker-backoff = {self.resilience.collective_breaker_backoff}",
+            f"collective-breaker-backoff-max = {self.resilience.collective_breaker_backoff_max}",
             "",
             "[rebalance]",
             f"online = {fmt(self.rebalance.online)}",
@@ -634,12 +700,20 @@ class Config:
             f"delta-max-fraction = {self.engine.delta_max_fraction}",
             f"delta-journal-ops = {self.engine.delta_journal_ops}",
             f"gather-workers = {self.engine.gather_workers}",
+            f"mesh-devices = {self.engine.mesh_devices}",
             f"leaf-cache-bytes = {self.engine.leaf_cache_bytes}",
             f"stack-cache-bytes = {self.engine.stack_cache_bytes}",
             f"memo-entries = {self.engine.memo_entries}",
             f"aux-memo-entries = {self.engine.aux_memo_entries}",
             f"dispatch-watchdog = {self.engine.dispatch_watchdog}",
             f"cold-host-count = {self.engine.cold_host_count}",
+            "",
+            "[collective]",
+            f"enabled = {self.collective.enabled}",
+            f"single-process = {self.collective.single_process}",
+            f"timeout-ms = {self.collective.timeout_ms}",
+            f"leaf-budget-bytes = {self.collective.leaf_budget_bytes}",
+            f"delta-max-fraction = {self.collective.delta_max_fraction}",
             "",
             "[tier]",
             f"hbm-bytes = {self.tier.hbm_bytes}",
@@ -705,6 +779,7 @@ class Config:
             storage_config=self.storage.validate(),
             ingest_config=self.ingest.validate(),
             engine_config=self.engine,
+            collective_config=self.collective,
             tier_config=self.tier.validate(),
             resilience_config=self.resilience.validate(),
             rebalance_config=self.rebalance.validate(),

@@ -190,6 +190,31 @@ class DiagnosticsCollector:
                 1 for p in snap.get("peers", {}).values()
                 if p.get("state") != "closed"
             )
+        # Collective-plane shape (docs/multichip.md): how much full-index
+        # serving rode the fused SPMD path vs fell back to the HTTP
+        # fan-out, how often barriers timed out, and how well the batched
+        # launches + resident stacks amortized the plane's fixed costs
+        # (per-reason fallback detail stays in /debug/vars).
+        coll = getattr(self.server, "collective", None)
+        if coll is not None:
+            snap = coll.snapshot()
+            info["collectiveServedCount"] = snap.get("served_count", 0)
+            info["collectiveServedTopN"] = snap.get("served_topn", 0)
+            info["collectiveServedBSI"] = snap.get("served_bsi", 0)
+            info["collectiveBatchedEntries"] = snap.get("batched_entries", 0)
+            info["collectiveBatchedLaunches"] = snap.get(
+                "batched_launches", 0)
+            info["collectiveBarrierTimeouts"] = snap.get(
+                "barrier_timeouts", 0)
+            info["collectiveFallbacks"] = sum(
+                snap.get("fallbacks", {}).values())
+            info["collectiveResidentHits"] = snap.get("resident_hits", 0)
+            info["collectiveDeltaHits"] = snap.get("delta_hits", 0)
+            health = snap.get("health", {})
+            info["collectivePlaneState"] = health.get("plane_state")
+            info["collectivePlaneOpened"] = health.get("plane_opened", 0)
+            info["collectiveSliceQuarantined"] = health.get(
+                "slice_quarantined", 0)
         # Elastic-rebalance shape: how much data live migrations have
         # moved, what cutovers cost the write path, and whether a job is
         # in flight right now (mid-job routing carries per-shard

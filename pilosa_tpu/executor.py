@@ -324,8 +324,12 @@ class Executor:
 
     def _collective_fallback(self, e) -> None:
         """Record WHY the fast path refused, where the decision was made —
-        a climbing CollectiveFallback counter is undiagnosable without it."""
-        self.holder.stats.count("CollectiveFallback", 1)
+        a climbing CollectiveFallback counter is undiagnosable without it.
+        The per-reason breakdown lands in the backend's `collective`
+        counter group (/debug/vars) next to its serve counters."""
+        self._count_stat("CollectiveFallback")
+        if self.collective is not None:
+            self.collective.note_fallback(getattr(e, "reason", "error"))
         self.logger.error("collective fallback: %s", e)
 
     # ----------------------------------------------------------- mapReduce
@@ -848,15 +852,30 @@ class Executor:
             raise QueryError("Count() only accepts a single bitmap input")
         child = c.children[0]
 
-        if self._collective_ok(index, shards, opt) and self.engine.supports(child, index):
-            from .parallel.collective import CollectiveUnavailable
+        if self._collective_ok(index, shards, opt):
+            supported = self.engine.supports(child, index)
+            if supported:
+                from .parallel.collective import CollectiveUnavailable
 
-            try:
-                result = int(self.collective.count(index, child))
-                self.holder.stats.count("CollectiveCount", 1)
-                return result
-            except CollectiveUnavailable as e:
-                self._collective_fallback(e)
+                try:
+                    if self.batcher is not None and supported is not True:
+                        # Batched collective launch: concurrent queries of
+                        # one canonical signature coalesce into ONE
+                        # barrier + ONE seq slot + ONE SPMD entry
+                        # (sched/batcher.py collective_count). The group
+                        # key is the SAME canonical sig the descriptor
+                        # carries — one helper, so they cannot drift.
+                        comp, _ = supported
+                        sig = self.collective._sig_tuple(comp)
+                        result = self.batcher.collective_count(
+                            self.collective, index, child, sig,
+                            deadline=opt.deadline)
+                    else:
+                        result = int(self.collective.count(index, child))
+                    self._count_stat("CollectiveCount")
+                    return result
+                except CollectiveUnavailable as e:
+                    self._collective_fallback(e)
 
         def map_fn(shard):
             return self._execute_bitmap_call_shard(index, child, shard).count()
@@ -1007,7 +1026,7 @@ class Executor:
                 result = self._collective_val_count(
                     index, field_name, bsig, kind, filter_call
                 )
-                self.holder.stats.count("CollectiveValCount", 1)
+                self._count_stat("CollectiveValCount")
                 return result
             except CollectiveUnavailable as e:
                 self._collective_fallback(e)
@@ -1225,7 +1244,7 @@ class Executor:
                         for r, cnt in zip(chunk, counts)
                         if cnt > 0
                     )
-                self.holder.stats.count("CollectiveTopN", 1)
+                self._count_stat("CollectiveTopN")
                 return sort_pairs(pairs)
             except CollectiveUnavailable as e:
                 self._collective_fallback(e)

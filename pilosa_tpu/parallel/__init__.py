@@ -28,6 +28,17 @@ class EngineConfig:
     delta_max_fraction: float = 0.25
     delta_journal_ops: int = 4096
     gather_workers: int = 0
+    # Engine mesh width: 0 = all local devices (default). A positive N
+    # restricts the per-node engine's mesh to the first N local devices.
+    # The operational reason is the multi-device CPU backend: concurrent
+    # sharded programs whose scalar reductions lower to cross-device
+    # all-reduces can interleave their rendezvous and deadlock (a
+    # jax-level hazard the micro-batcher only narrows), so CPU
+    # deployments that want the COLLECTIVE plane on the full device set
+    # pin the engine to mesh-devices=1 — per-node programs then carry no
+    # collectives at all and only the (runner-serialized) collective
+    # plane uses the full mesh. docs/multichip.md.
+    mesh_devices: int = 0
     # Cache budgets (0 = auto). Auto means: the legacy env override
     # (PILOSA_LEAF_CACHE_BYTES / PILOSA_STACK_CACHE_BYTES /
     # PILOSA_MEMO_ENTRIES / PILOSA_AUX_MEMO_ENTRIES) if set, else the
@@ -61,3 +72,37 @@ class EngineConfig:
     # instead of one per dispatch site / shard batch / TopN chunk. 0
     # recompiles every time (escape hatch).
     plan_cache: int = 1
+
+
+# The [collective] config section (docs/multichip.md) — jax-free here for
+# the same reason as EngineConfig: config.py/cli.py import it at startup.
+@dataclass
+class CollectiveConfig:
+    """Multi-host collective serving plane knobs
+    (parallel/collective.py).
+
+    enabled: 0 turns the collective rung off entirely (every full-index
+        query takes the HTTP fan-out) — the escape hatch.
+    single_process: 1 lets a single-process job with a single-node
+        cluster serve through the collective plane over its LOCAL device
+        mesh (a one-pod deployment whose chips hold the whole index; the
+        barrier degenerates to a no-op). Default 0: multi-node clusters
+        must span a real jax.distributed job.
+    timeout_ms: barrier timeout — how long a process waits for its peers
+        before aborting a collective entry (PILOSA_COLLECTIVE_TIMEOUT_MS
+        env keeps working as the per-process override).
+    leaf_budget_bytes: resident sharded-stack budget per process; LRU
+        past it, evicted planes demote through the tier manager
+        (PILOSA_COLLECTIVE_LEAF_BYTES env override).
+    delta_max_fraction: same contract as [engine] delta-max-fraction,
+        for the collective plane's resident stacks: a stale resident
+        global array refreshes by a per-device scattered update while
+        the changed words stay under this fraction. 0 disables deltas
+        (every staleness is a full re-assembly).
+    """
+
+    enabled: int = 1
+    single_process: int = 0
+    timeout_ms: int = 10000
+    leaf_budget_bytes: int = 1 << 28
+    delta_max_fraction: float = 0.25

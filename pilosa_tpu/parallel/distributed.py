@@ -59,14 +59,22 @@ def initialize(coordinator_address: Optional[str] = None,
     return True
 
 
-def global_mesh():
+def global_mesh(limit: Optional[int] = None):
     """1-D mesh over every device in the job — all hosts' chips after
     initialize(), just the local ones otherwise. XLA partitions programs
-    over it and inserts ICI collectives within a host, DCN across hosts."""
+    over it and inserts ICI collectives within a host, DCN across hosts.
+
+    `limit` restricts the mesh to the first N devices — single-process
+    only (the MULTICHIP bench's per-device-count scaling curve); a
+    multi-process subset would break the process-contiguous slot layout
+    the collective plane verifies."""
     import jax
     from jax.sharding import Mesh
 
-    return Mesh(np.array(jax.devices()), (SHARD_AXIS,))
+    devs = jax.devices()
+    if limit:
+        devs = devs[: int(limit)]
+    return Mesh(np.array(devs), (SHARD_AXIS,))
 
 
 def process_shard_slots(n_shards: int) -> tuple:

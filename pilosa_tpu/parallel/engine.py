@@ -174,7 +174,20 @@ class ShardedQueryEngine:
     def __init__(self, holder, mesh=None, config: Optional[EngineConfig] = None,
                  tier_config=None, traffic_fn=None, resilience_config=None):
         self.holder = holder
-        self.mesh = mesh if mesh is not None else default_mesh()
+        if mesh is None:
+            # [engine] mesh-devices: a positive N pins the engine to the
+            # first N local devices (see EngineConfig for the concurrent-
+            # all-reduce rationale); 0 = all local devices.
+            md = int(getattr(config, "mesh_devices", 0) or 0) if config \
+                else int(os.environ.get("PILOSA_TPU_ENGINE_MESH_DEVICES",
+                                        "0"))
+            if md > 0:
+                import jax as _jax
+
+                mesh = default_mesh(_jax.local_devices()[:md])
+            else:
+                mesh = default_mesh()
+        self.mesh = mesh
         if config is None:
             # No resolved config (library/test/bench use): honor the env
             # spellings directly. When a Config DID resolve these knobs,

@@ -142,6 +142,88 @@ class MicroBatcher:
             return engine.count(index, call, shards, comp_expr=comp_expr)
         return engine.bitmap(index, call, shards, comp_expr=comp_expr)
 
+    # -------------------------------------------------- collective plane
+
+    def collective_count(self, backend, index: str, call, sig,
+                         deadline: Optional[Deadline] = None) -> int:
+        """Count(call) through the multi-host collective plane
+        (parallel/collective.py), coalesced with compatible concurrent
+        requests into ONE collective entry: one barrier, one KV sequence
+        slot, one SPMD program for the whole group — the collective
+        path's dominant fixed costs amortize across the batch
+        (docs/multichip.md). `sig` is the call's CANONICAL plan
+        signature (respellings share a group). Raises
+        CollectiveUnavailable through to the caller, whose fallback is
+        the HTTP fan-out."""
+        window = self.effective_window()
+        if window <= 0:
+            obs_record("batch.hold", 0.0, held=0)
+            return int(backend.count(index, call))
+        key = ("ccount", index, sig)
+        item = _Item(call, None)
+        with self._lock:
+            group = self._pending.get(key)
+            leader = group is None or group.closed
+            if leader:
+                group = _Group()
+                self._pending[key] = group
+            group.items.append(item)
+            self.counters["enqueued"] += 1
+            if len(group.items) >= self.batch_max:
+                group.closed = True
+                if self._pending.get(key) is group:
+                    del self._pending[key]
+                group.full.set()
+        if leader:
+            with obs_span("batch.hold", role="leader", held=1):
+                self.wait_window(group, window)
+            self._run_collective(key, group, backend, index)
+        else:
+            budget = 30.0
+            if deadline is not None:
+                budget = max(0.0, min(budget, deadline.remaining()))
+            with obs_span("batch.hold", role="follower", held=1):
+                answered = item.event.wait(
+                    timeout=budget + 10 * self.window_max)
+            if not answered:
+                with self._lock:
+                    self.counters["fallbacks"] += 1
+                if deadline is not None:
+                    deadline.check("micro-batch wait")
+                return int(backend.count(index, call))
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def _run_collective(self, key, group: _Group, backend, index: str) -> None:
+        with self._lock:
+            if self._pending.get(key) is group:
+                del self._pending[key]
+            group.closed = True
+            items = list(group.items)
+        try:
+            if len(items) == 1:
+                results = [backend.count(index, items[0].call)]
+            else:
+                results = backend.count_batch(
+                    index, [it.call for it in items])
+            for it, r in zip(items, results):
+                it.result = int(r)
+        except BaseException as e:
+            # Every member sees the group's error — typically
+            # CollectiveUnavailable, which each caller's executor catches
+            # and serves through its own fan-out fallback.
+            for it in items:
+                it.error = e
+        finally:
+            with self._lock:
+                self.counters["launches"] += 1
+                self.counters["coalesced"] += len(items) - 1
+            if self.stats:
+                self.stats.histogram("SchedulerBatchSize", len(items))
+            for it in items:
+                it.event.set()
+
     def _submit(self, kind: str, index: str, call, shards, comp_expr,
                 deadline: Optional[Deadline]):
         engine = self.get_engine()

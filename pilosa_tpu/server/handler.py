@@ -752,6 +752,14 @@ class Handler:
         # peer costs zero connect attempts between half-open probes" and
         # "replica retries stayed inside the budget".
         out["resilience"] = self.api.server.cluster.health.snapshot()
+        # Collective-plane health (docs/multichip.md): served/batched
+        # counts, fallbacks BY REASON, barrier timeouts, resident-stack
+        # hit/delta/eviction counters, and the plane/slice breaker states
+        # — the on-call question when full-index qps drops is "did the
+        # fast path stop serving, and WHY did it refuse".
+        coll = getattr(self.api.server, "collective", None)
+        if coll is not None:
+            out["collective"] = coll.snapshot()
         # Live-rebalance health (docs/rebalance.md): fragments moved vs
         # pending, bytes streamed, catch-up rounds, cutover write-pause
         # percentiles, and the routing epoch — the on-call question during

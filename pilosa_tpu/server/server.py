@@ -64,6 +64,7 @@ class Server:
         storage_config=None,
         ingest_config=None,
         engine_config=None,
+        collective_config=None,
         tier_config=None,
         obs_config=None,
         join_addr: Optional[str] = None,
@@ -256,6 +257,8 @@ class Server:
         )
         self.resize_coordinator = None  # set on demand by coordinators
         self.collective = None  # CollectiveBackend, constructed in open()
+        # Resolved [collective] section (None = backend env fallbacks).
+        self.collective_config = collective_config
         self._httpd = None
         self._http_thread = None
         self._join_lock = threading.Lock()  # admission may race solicit vs HTTP
@@ -312,7 +315,7 @@ class Server:
         # every server — single-process jobs degenerate to the local mesh.
         from ..parallel.collective import CollectiveBackend
 
-        self.collective = CollectiveBackend(self)
+        self.collective = CollectiveBackend(self, self.collective_config)
         self.executor.collective = self.collective
         self.executor.logger = self.logger
         self.translate_store.open()

@@ -167,6 +167,28 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
         "TIER", tier,
         lambda t: t["tiered"]["qps"] > t["drop_regather"]["qps"], tmp_path)
     assert tier["tiered"]["qps"] > tier["drop_regather"]["qps"], tier
+    # The MULTICHIP stanza is the collective-plane acceptance metric
+    # (docs/multichip.md): every answer on BOTH paths must equal the
+    # host-computed reference (warm and under concurrency), the fast
+    # path must actually have served (a silent fallback would fake the
+    # ratio), and the barrier-timeout chaos leg must serve with zero
+    # wrong answers and zero errors, then re-close the plane breaker
+    # once the fault clears. All correctness gates — never retried.
+    # The batched resident-stack collective vs HTTP fan-out qps ratio
+    # is a timing gate: one isolation rerun per the TIER-flake
+    # precedent.
+    mc = detail["multichip"]
+    assert mc["bit_exact"], mc
+    assert mc["collective_served"], mc
+    assert mc["chaos"]["wrong_answers"] == 0, mc
+    assert mc["chaos"]["errors"] == 0, mc
+    assert mc["chaos"]["barrier_timeouts"] >= 1, mc
+    assert mc["chaos"]["plane_opened"] >= 1, mc
+    assert mc["chaos"]["recovered"], mc
+    mc = _retry_ratio_gate(
+        "MULTICHIP", mc,
+        lambda m: m["collective_vs_fanout"] >= 1.5, tmp_path)
+    assert mc["collective_vs_fanout"] >= 1.5, mc
     # The OBS stanza is the tracing acceptance metric: sample-rate 1.0
     # must hold qps within 5% of tracing-disabled on the SCHED-shaped
     # workload (ratio gate: one isolation rerun), every query must land

@@ -325,6 +325,491 @@ def test_two_process_cluster_collective_queries(tmp_path, n_proc):
     assert any("WORKER1_OK" in out for _, out, _ in outs)
 
 
+# --------------------------- resident stacks / batching / health (PR 12)
+
+
+def _pod(holder, **cfg_kw):
+    """Single-process, single-node backend over `holder` — the one-pod
+    serving mode ([collective] single-process) every PR 12 unit test
+    drives; the barrier degenerates to a no-op and the mesh is the
+    8-device test mesh."""
+    from types import SimpleNamespace
+
+    from pilosa_tpu.logger import NopLogger
+    from pilosa_tpu.parallel import CollectiveConfig
+    from pilosa_tpu.parallel.collective import CollectiveBackend
+
+    node = Node(id="n0", process_idx=0)
+    cluster = Cluster(node=node, nodes=[node], replica_n=1)
+    server = SimpleNamespace(
+        holder=holder, logger=NopLogger(), cluster=cluster, client=None,
+    )
+    cfg_kw.setdefault("single_process", 1)
+    backend = CollectiveBackend(server, CollectiveConfig(**cfg_kw))
+    return backend, server
+
+
+def _plant(holder, n_shards=4, rows=(1, 2, 3)):
+    from pilosa_tpu.constants import SHARD_WIDTH
+
+    idx = holder.create_index_if_not_exists("ci")
+    idx.create_field_if_not_exists("f")
+    rng = np.random.default_rng(7)
+    exp = {}
+    for row in rows:
+        cols = []
+        for s in range(n_shards):
+            local = np.flatnonzero(rng.random(2048) < 0.1)
+            cols.extend(int(s * SHARD_WIDTH + c) for c in local)
+        idx.field("f").import_bits([row] * len(cols), cols)
+        exp[row] = set(cols)
+    return idx, exp
+
+
+def _call(q):
+    from pilosa_tpu.pql.parser import parse
+
+    return parse(q).calls[0].children[0]
+
+
+@pytest.fixture
+def holder():
+    from pilosa_tpu.core.holder import Holder
+
+    h = Holder(None)
+    h.open()
+    yield h
+    h.close()
+
+
+def test_single_process_active_requires_single_node(holder):
+    backend, server = _pod(holder)
+    try:
+        assert backend.active()
+        server.cluster.nodes.append(Node(id="n1", process_idx=None))
+        # Two nodes, one process: remote shards would read as silently
+        # empty — the plane must refuse.
+        assert not backend.active()
+    finally:
+        backend.close()
+
+
+def test_respellings_share_descriptor_sig_and_program(holder):
+    """Satellite: the descriptor signature is the CANONICAL plan
+    signature, so commutative respellings share one collective
+    descriptor signature and ONE compiled collective program."""
+    _, exp = _plant(holder)
+    backend, _ = _pod(holder)
+    try:
+        a = _call("Count(Intersect(Row(f=1), Row(f=2)))")
+        b = _call("Count(Intersect(Row(f=2), Row(f=1)))")
+        assert backend._call_sig("ci", a) == backend._call_sig("ci", b)
+        want = len(exp[1] & exp[2])
+        assert backend.count("ci", a) == want
+        assert backend.count("ci", b) == want
+        count_fns = [k for k in backend._fn_cache if k[0] == "count"]
+        assert len(count_fns) == 1, count_fns
+    finally:
+        backend.close()
+
+
+def test_count_batch_is_one_entry(holder):
+    """A batch of N same-signature queries costs ONE collective entry
+    (one seq slot, one barrier, one SPMD program), with duplicates
+    deduped inside the program and fanned back out."""
+    _, exp = _plant(holder)
+    backend, _ = _pod(holder)
+    try:
+        c12 = _call("Count(Intersect(Row(f=1), Row(f=2)))")
+        c13 = _call("Count(Intersect(Row(f=1), Row(f=3)))")
+        got = backend.count_batch("ci", [c12, c13, c12, c13])
+        assert got == [len(exp[1] & exp[2]), len(exp[1] & exp[3])] * 2
+        assert backend.counters["entries"] == 1
+        assert backend.counters["batched_entries"] == 4
+        assert backend.counters["batched_launches"] == 1
+    finally:
+        backend.close()
+
+
+def test_resident_stack_delta_refresh(holder):
+    """A write to a resident plane refreshes it by a scattered delta
+    (dirty-word journal), not a full re-assembly — and the refreshed
+    count is bit-exact."""
+    idx, exp = _plant(holder)
+    backend, _ = _pod(holder)
+    try:
+        c = _call("Count(Intersect(Row(f=1), Row(f=2)))")
+        assert backend.count("ci", c) == len(exp[1] & exp[2])
+        full0 = backend.counters["full_refreshes"]
+        assert backend.count("ci", c) == len(exp[1] & exp[2])
+        assert backend.counters["resident_hits"] >= 2  # warm: no refresh
+        assert backend.counters["full_refreshes"] == full0
+        # One-bit write: delta path, not re-assembly.
+        idx.field("f").import_bits([1], [5])
+        exp[1].add(5)
+        assert backend.count("ci", c) == len(exp[1] & exp[2])
+        assert backend.counters["delta_hits"] >= 1
+        assert backend.counters["full_refreshes"] == full0
+    finally:
+        backend.close()
+
+
+def test_resident_stack_delta_disabled(holder):
+    """delta-max-fraction=0 turns deltas off: every staleness is a full
+    re-assembly (the escape hatch), still bit-exact."""
+    idx, exp = _plant(holder)
+    backend, _ = _pod(holder, delta_max_fraction=0.0)
+    try:
+        c = _call("Count(Intersect(Row(f=1), Row(f=2)))")
+        assert backend.count("ci", c) == len(exp[1] & exp[2])
+        full0 = backend.counters["full_refreshes"]
+        idx.field("f").import_bits([1], [5])
+        exp[1].add(5)
+        assert backend.count("ci", c) == len(exp[1] & exp[2])
+        assert backend.counters["delta_hits"] == 0
+        assert backend.counters["full_refreshes"] > full0
+    finally:
+        backend.close()
+
+
+def test_bsi_stack_resident_across_queries(holder):
+    """The BSI plane stack is resident: a repeat Sum re-uses the cached
+    (D+1, S, W) stack instead of re-walking containers."""
+    from pilosa_tpu.core.field import FieldOptions
+
+    idx, _ = _plant(holder)
+    idx.create_field_if_not_exists(
+        "v", FieldOptions(type="int", min=0, max=255))
+    for col, val in [(3, 10), (9, 20), (700, 30)]:
+        idx.field("v").set_value(col, val)
+    backend, _ = _pod(holder)
+    try:
+        depth = idx.field("v").bsi_group("v").bit_depth()
+        counts = backend.bsi_val_count("ci", "v", "sum", depth)
+        full0 = backend.counters["full_refreshes"]
+        counts2 = backend.bsi_val_count("ci", "v", "sum", depth)
+        assert list(counts) == list(counts2)
+        assert backend.counters["full_refreshes"] == full0
+        assert backend.counters["resident_hits"] >= 1
+    finally:
+        backend.close()
+
+
+def test_delete_recreate_never_aliases_resident_planes(holder):
+    """Satellite: the incarnation half of the fingerprint means a
+    deleted-and-recreated index whose fresh generation counters climb
+    back can never alias the old index's resident planes (the hazard
+    the plane-assembly comment warned about; now asserted)."""
+    from pilosa_tpu.constants import SHARD_WIDTH
+
+    idx, exp = _plant(holder, n_shards=2)
+    backend, _ = _pod(holder)
+    try:
+        c = _call("Count(Intersect(Row(f=1), Row(f=2)))")
+        old = backend.count("ci", c)
+        assert old == len(exp[1] & exp[2]) and old > 0
+        holder.delete_index("ci")
+        idx = holder.create_index_if_not_exists("ci")
+        idx.create_field_if_not_exists("f")
+        # Fresh data: rows 1 and 2 share exactly one column, imported
+        # with enough bits that bare generation counters climb back
+        # toward cached values.
+        cols1 = [1, 9, SHARD_WIDTH + 4]
+        cols2 = [9, 70, SHARD_WIDTH + 8]
+        idx.field("f").import_bits([1] * len(cols1), cols1)
+        idx.field("f").import_bits([2] * len(cols2), cols2)
+        got = backend.count("ci", _call("Count(Intersect(Row(f=1), Row(f=2)))"))
+        assert got == 1, got  # the old answer would be `old`
+    finally:
+        backend.close()
+
+
+def test_enter_refuses_epoch_divergence(holder):
+    """Epoch-aware membership: a peer whose routing epoch diverges from
+    the descriptor's refuses BEFORE computing (the leader's fan-out
+    fallback serves the query under its own epoch gates)."""
+    _plant(holder)
+    backend, server = _pod(holder)
+    try:
+        c = _call("Count(Row(f=1))")
+        desc = backend._descriptor("count", "ci", queries=[str(c)],
+                                   sig=backend._call_sig("ci", c))
+        desc["seq"] = 1
+        desc["epoch"] = server.cluster.routing_epoch + 3  # leader is ahead
+        with pytest.raises(CollectiveUnavailable, match="epoch") as ei:
+            backend._enter(desc)
+        assert ei.value.reason == "epoch"
+        assert backend.counters["stale_epoch_refusals"] == 1
+        # Topology churn must NOT advance the plane breaker.
+        assert backend.health.plane_state() == "closed"
+    finally:
+        backend.close()
+
+
+def test_enter_discards_result_when_epoch_advances_mid_execution(holder):
+    """A cutover committing while planes are being assembled discards
+    the collective result (post-commit GC may have read a moved shard
+    as silently empty) — the leader re-runs through the fan-out."""
+    _plant(holder)
+    backend, server = _pod(holder)
+    try:
+        c = _call("Count(Row(f=1))")
+        desc = backend._descriptor("count", "ci", queries=[str(c)],
+                                   sig=backend._call_sig("ci", c))
+        desc["seq"] = 1
+        orig = backend._run_count
+
+        def bump_then_run(*a, **kw):
+            server.cluster.routing_epoch += 1
+            return orig(*a, **kw)
+
+        backend._run_count = bump_then_run
+        with pytest.raises(CollectiveUnavailable, match="advanced") as ei:
+            backend._enter(desc)
+        assert ei.value.reason == "epoch"
+        assert backend.counters["epoch_rechecks"] == 1
+    finally:
+        backend.close()
+
+
+def test_placement_follows_committed_cutover():
+    """Mid-rebalance, a committed cutover's shard routes to its NEW
+    owner in the descriptor placement — the refreshed-descriptor half
+    of the acceptance criterion (the stale-view halves are covered by
+    ownership verification + the epoch gates)."""
+    nodes = [Node(id="n0", process_idx=0), Node(id="n1", process_idx=1)]
+    c = Cluster(node=nodes[0], nodes=nodes, replica_n=1, hasher=ModHasher())
+    before = placement(c, "i", 4, 2)
+    # n0 leaves the cluster: its shards migrate to n1; one cutover has
+    # committed so far.
+    moved = before[0][0]
+    c.begin_rebalance([nodes[1]])
+    c.apply_cutover("i", moved)
+    after = placement(c, "i", 4, 2)
+    assert moved in after[1] and moved not in after[0]
+    # Everything else stays put mid-job (no holes).
+    assert sorted(after[0] + after[1]) == list(range(4))
+
+
+def test_barrier_failpoint_opens_breaker_then_recovers(holder):
+    """Chaos ladder: barrier failures open the plane breaker after
+    `collective-breaker-failures`; once open, queries short-circuit
+    INSTANTLY (no barrier wait); after the fault clears, the half-open
+    probe query re-closes the plane."""
+    from pilosa_tpu import failpoints
+    from pilosa_tpu.cluster.health import ResilienceConfig
+    from pilosa_tpu.parallel.device_health import CollectivePlaneHealth
+
+    _, exp = _plant(holder)
+    backend, _ = _pod(holder)
+    clock = [1000.0]
+    backend.health = CollectivePlaneHealth(
+        ResilienceConfig(collective_breaker_failures=2,
+                         collective_breaker_backoff=1.0).validate(),
+        clock=lambda: clock[0])
+    try:
+        c = _call("Count(Intersect(Row(f=1), Row(f=2)))")
+        want = len(exp[1] & exp[2])
+        assert backend.count("ci", c) == want
+        failpoints.configure("collective-barrier", "error")
+        for _ in range(2):
+            with pytest.raises(CollectiveUnavailable) as ei:
+                backend.count("ci", c)
+            assert ei.value.reason == "barrier-timeout"
+        assert backend.counters["barrier_timeouts"] == 2
+        assert backend.health.plane_state() == "open"
+        # Open plane: instant refusal, no barrier wait, no seq burned.
+        seq_before = backend._local_seq
+        with pytest.raises(CollectiveUnavailable) as ei:
+            backend.count("ci", c)
+        assert ei.value.reason == "breaker-open"
+        assert backend._local_seq == seq_before
+        assert backend.counters["breaker_short_circuits"] == 1
+        # Fault clears; after the backoff the next query is the probe
+        # and re-closes the plane.
+        failpoints.reset()
+        clock[0] += 10.0
+        assert backend.count("ci", c) == want
+        assert backend.health.plane_state() == "closed"
+    finally:
+        failpoints.reset()
+        backend.close()
+
+
+def test_mesh_width_never_aliases_resident_planes(holder):
+    """Review regression: the resident-cache key carries the mesh width.
+    n_shards=4 pads to k=4 at BOTH mesh_devices=4 and =2, so without the
+    width in the key the second count would resident-hit the 4-device
+    layout's array — a silently wrong device layout (and a fabricated
+    bench scaling curve)."""
+    _, exp = _plant(holder)
+    backend, _ = _pod(holder)
+    try:
+        c = _call("Count(Intersect(Row(f=1), Row(f=2)))")
+        want = len(exp[1] & exp[2])
+        backend.mesh_devices = 4
+        assert backend.count("ci", c) == want
+        full0 = backend.counters["full_refreshes"]
+        backend.mesh_devices = 2
+        assert backend.count("ci", c) == want
+        assert backend.counters["full_refreshes"] > full0
+    finally:
+        backend.close()
+
+
+def test_allow_never_orphans_plane_probe_on_blocked_slice():
+    """Review regression: allow() must due-check EVERY breaker before
+    claiming any probe — a plane probe claimed and then short-circuited
+    by a still-backed-off slice would expire as a failure and double the
+    plane backoff from short-circuits alone."""
+    from pilosa_tpu.cluster.health import ResilienceConfig
+    from pilosa_tpu.parallel.device_health import CollectivePlaneHealth
+
+    clock = [0.0]
+    h = CollectivePlaneHealth(
+        ResilienceConfig(collective_breaker_failures=1,
+                         collective_breaker_backoff=2.0).validate(),
+        clock=lambda: clock[0])
+    h.record_failure("runtime")  # t=0: plane opens
+    clock[0] = 1.0
+    h.record_failure("broadcast", [1])  # t=1: slice 1 opens
+    clock[0] = 2.5  # plane due (>= 2.0), slice NOT due (>= 3.0)
+    assert not h.allow([0, 1])
+    assert h.plane_state() == "open"  # no wedged half-open probe
+    assert h.counters["plane_probes"] == 0
+    assert h.counters["slice_short_circuits"] == 1
+    clock[0] = 3.5  # both due: joint probe, one entry resolves both
+    assert h.allow([0, 1])
+    h.record_success([0, 1])
+    assert h.plane_state() == "closed"
+    assert h.slice_state(1) == "closed"
+
+
+def test_broadcast_failure_quarantines_slice():
+    from pilosa_tpu.cluster.health import ResilienceConfig
+    from pilosa_tpu.parallel.device_health import CollectivePlaneHealth
+
+    clock = [0.0]
+    h = CollectivePlaneHealth(
+        ResilienceConfig(collective_breaker_failures=1,
+                         collective_breaker_backoff=2.0).validate(),
+        clock=lambda: clock[0])
+    assert h.allow([0, 1])
+    h.record_failure("broadcast", [1])
+    assert h.slice_state(1) == "open"
+    # Plane opened too (failures=1); both short-circuit this entry.
+    assert not h.allow([0, 1])
+    clock[0] += 2.5
+    assert h.allow([0, 1])  # half-open probe claimed
+    h.record_success([0, 1])
+    assert h.slice_state(1) == "closed"
+    assert h.plane_state() == "closed"
+
+
+def test_executor_falls_back_cleanly_and_counts_reason(holder):
+    """A refusing collective plane is a performance event, not an
+    availability event: the executor serves the query through the
+    fan-out and the refusal reason lands in the collective counter
+    group (satellite: fallback-by-reason observability)."""
+    from pilosa_tpu.executor import Executor
+
+    _, exp = _plant(holder)
+    backend, server = _pod(holder)
+    ex = Executor(holder, cluster=server.cluster, workers=0)
+    ex.collective = backend
+    server.executor = ex
+    try:
+        def refuse(index, call):
+            raise CollectiveUnavailable("mid-rebalance window",
+                                        reason="epoch")
+
+        backend.count = refuse
+        got = ex.execute("ci", "Count(Intersect(Row(f=1), Row(f=2)))")
+        assert got[0] == len(exp[1] & exp[2])
+        assert backend.fallbacks == {"epoch": 1}
+    finally:
+        backend.close()
+        ex.close()
+
+
+def test_collective_eviction_demotes_to_tier(holder):
+    """Resident-stack eviction is DEMOTION: past the leaf budget, the
+    LRU plane's compressed image lands in the engine's tier manager, and
+    the next cold assembly promotes from it instead of walking live
+    containers."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.tier import TierConfig
+
+    _, exp = _plant(holder)
+    # One (8, W) plane block is 1 MiB on the 8-device mesh: budget fits
+    # ~2 planes, so the third leaf evicts the first.
+    backend, server = _pod(holder, leaf_budget_bytes=2 * (1 << 20) + (1 << 16))
+    ex = Executor(holder, cluster=server.cluster, workers=0,
+                  tier_config=TierConfig(host_bytes=1 << 24))
+    server.executor = ex
+    assert ex.engine.tier is not None
+    try:
+        for row in (1, 2, 3):
+            backend.count("ci", _call(f"Count(Row(f={row}))"))
+        assert backend.counters["evictions"] >= 1
+        ex.engine.tier.drain()
+        assert backend.counters["demotions"] >= 1
+        # Re-touch the evicted plane: assembled from the compressed
+        # image, bit-exact.
+        tp0 = backend.counters["tier_promotes"]
+        assert backend.count("ci", _call("Count(Row(f=1))")) == len(exp[1])
+        assert backend.counters["tier_promotes"] > tp0
+    finally:
+        backend.close()
+        ex.close()
+
+
+def test_batcher_coalesces_collective_counts(holder):
+    """sched/batcher.py collective_count: concurrent same-signature
+    Counts coalesce into ONE backend entry (count_batch), results split
+    back bit-exact."""
+    import threading
+
+    from pilosa_tpu.sched import MicroBatcher
+
+    _, exp = _plant(holder)
+    backend, _ = _pod(holder)
+    release = threading.Event()
+
+    def wait_window(group, window):
+        release.wait(timeout=10)
+
+    b = MicroBatcher(lambda: None, window=0.001, window_max=0.05,
+                     batch_max=8, depth_fn=lambda: 8,
+                     wait_window=wait_window)
+    try:
+        c12 = _call("Count(Intersect(Row(f=1), Row(f=2)))")
+        c21 = _call("Count(Intersect(Row(f=2), Row(f=1)))")
+        sig = ("sig",)
+        results = {}
+        threads = []
+
+        def run(i, call):
+            results[i] = b.collective_count(backend, "ci", call, sig)
+
+        for i, call in enumerate([c12, c21, c12, c21]):
+            t = threading.Thread(target=run, args=(i, call))
+            t.start()
+            threads.append(t)
+        deadline = time.time() + 5
+        while b.snapshot()["enqueued"] < 4 and time.time() < deadline:
+            time.sleep(0.005)
+        release.set()
+        for t in threads:
+            t.join(timeout=10)
+        want = len(exp[1] & exp[2])
+        assert results == {0: want, 1: want, 2: want, 3: want}
+        assert backend.counters["entries"] == 1  # ONE collective entry
+        assert b.snapshot()["coalesced"] == 3
+    finally:
+        backend.close()
+
+
 def test_runner_rejects_stale_seq():
     """A gap-skipped descriptor arriving late must be rejected, not
     executed — its barrier peers already timed out."""

@@ -88,6 +88,21 @@ def test_engine_leaf_is_sharded(holder, ex):
     assert len({s.device for s in arr.addressable_shards}) == 8
 
 
+def test_engine_mesh_devices_knob(holder, ex):
+    """[engine] mesh-devices pins the engine to the first N local
+    devices — per-node programs then carry no cross-device all-reduces
+    (the CPU concurrent-rendezvous hazard, docs/multichip.md) — and
+    results stay bit-exact."""
+    from pilosa_tpu.parallel import EngineConfig
+
+    expected = plant(holder, ex)
+    engine = ShardedQueryEngine(holder, config=EngineConfig(mesh_devices=1))
+    assert engine.n_devices == 1
+    call = parse("Intersect(Row(f=1), Row(g=3))").calls[0]
+    want = len(expected[("f", 1)] & expected[("g", 3)])
+    assert engine.count("i", call, list(range(5))) == want
+
+
 def test_engine_executor_integration(holder, ex):
     expected = plant(holder, ex)
     want = len(expected[("f", 1)] & expected[("g", 3)])

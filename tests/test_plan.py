@@ -89,6 +89,39 @@ def test_respellings_share_one_compiled_program(holder, monkeypatch):
     assert len(sigs) == 1
 
 
+def test_respellings_share_collective_descriptor_and_program(holder):
+    """The COLLECTIVE plane's descriptor signature is the same canonical
+    plan signature (parallel/collective.py _call_sig): every respelling
+    in the corpus produces one descriptor sig, shares one collective
+    compiled program, and answers identically through the one-pod
+    collective path (PR 12 satellite)."""
+    from types import SimpleNamespace
+
+    from pilosa_tpu.cluster.node import Cluster, Node
+    from pilosa_tpu.logger import NopLogger
+    from pilosa_tpu.parallel import CollectiveConfig
+    from pilosa_tpu.parallel.collective import CollectiveBackend
+
+    node = Node(id="n0", process_idx=0)
+    backend = CollectiveBackend(
+        SimpleNamespace(
+            holder=holder, logger=NopLogger(),
+            cluster=Cluster(node=node, nodes=[node], replica_n=1),
+            client=None,
+        ),
+        CollectiveConfig(single_process=1),
+    )
+    try:
+        sigs = {backend._call_sig("i", tree(q)) for q in RESPELLINGS}
+        assert len(sigs) == 1, sigs
+        results = {backend.count("i", tree(q)) for q in RESPELLINGS}
+        assert len(results) == 1
+        count_fns = [k for k in backend._fn_cache if k[0] == "count"]
+        assert len(count_fns) == 1, count_fns
+    finally:
+        backend.close()
+
+
 def test_respellings_share_memo(holder):
     """With memos on, a respelling of an answered query is a memo hit —
     no second dispatch at all."""
