@@ -707,6 +707,7 @@ class Config:
             f"aux-memo-entries = {self.engine.aux_memo_entries}",
             f"dispatch-watchdog = {self.engine.dispatch_watchdog}",
             f"cold-host-count = {self.engine.cold_host_count}",
+            f"plan-cache = {self.engine.plan_cache}",
             "",
             "[collective]",
             f"enabled = {self.collective.enabled}",

@@ -27,6 +27,16 @@ source line (or the line above) carries `# pilint: allow-blocking(reason)`
 is not a finding. Lock-order cycles have no annotation escape — order
 them or fix them.
 
+Schedule perturbation (opt-in, PILOSA_TPU_LOCKCHECK_SCHED=<seed>): the
+lock proxies inject tiny seeded randomized yields at acquire boundaries,
+widening the interleavings the instrumented chaos smokes explore beyond
+what the OS scheduler happens to pick. Every yield decision is drawn
+from ONE seeded PRNG serialized behind the checker's raw lock, so a
+given acquire sequence replays deterministically under the same seed
+(tests/test_lockcheck.py proves it); the yield sleeps through the
+ORIGINAL time.sleep, so the perturbation can never self-report as a
+blocking-under-lock finding.
+
 Stdlib-only, and all checker state lives at module level guarded by a
 RAW (_thread.allocate_lock) lock so the checker cannot deadlock with or
 instrument itself.
@@ -60,6 +70,15 @@ _tls = threading.local()
 _annot_cache: Dict[str, Set[int]] = {}  # filename -> annotated line numbers
 
 _orig: Dict[str, object] = {}
+
+# Schedule perturbation: seeded RNG + decision trace (for deterministic-
+# replay assertions), armed by configure_sched(). Probability and sleep
+# ceiling are deliberately tiny — the point is nudging interleavings,
+# not slowing the suite.
+_sched: Dict[str, object] = {"rng": None, "trace": []}
+_SCHED_YIELD_P = 0.25
+_SCHED_MAX_SLEEP = 0.0005
+_SCHED_TRACE_CAP = 20000
 
 _SKIP_FILES = (os.sep + "devtools" + os.sep + "lockcheck",
                os.sep + "threading.py")
@@ -199,6 +218,48 @@ def _note_released(proxy) -> None:
             return
 
 
+# ---------------------------------------------------- schedule perturbation
+
+
+def configure_sched(seed: Optional[int]) -> None:
+    """Arm (or, with None, disarm) the acquire-boundary perturbation.
+    Re-arming with the same seed restarts the decision sequence — the
+    deterministic-replay contract."""
+    import random
+
+    with _glock:
+        _sched["rng"] = None if seed is None else random.Random(int(seed))
+        _sched["trace"] = []
+
+
+def sched_trace():
+    """The (yielded, delay) decision sequence drawn so far — what the
+    determinism test asserts replays exactly under one seed."""
+    with _glock:
+        return list(_sched["trace"])
+
+
+def _sched_yield() -> None:
+    """Maybe sleep a tiny seeded-random interval before an acquire. The
+    draw is serialized behind the checker lock (one global sequence);
+    the sleep itself happens OUTSIDE it, through the original
+    time.sleep so the deny-list wrapper never sees it."""
+    with _glock:
+        rng = _sched["rng"]
+        if rng is None:
+            return
+        r = rng.random()
+        yielded = r < _SCHED_YIELD_P
+        delay = (r / _SCHED_YIELD_P) * _SCHED_MAX_SLEEP if yielded else 0.0
+        trace = _sched["trace"]
+        trace.append((yielded, round(delay, 7)))
+        if len(trace) > _SCHED_TRACE_CAP:
+            del trace[: _SCHED_TRACE_CAP // 2]
+    if yielded:
+        sleep = _orig.get("time.sleep") or time.sleep
+        sleep(delay)
+
+
 # ----------------------------------------------------------- lock proxies
 
 
@@ -221,6 +282,7 @@ class _LockProxy:
             _sites[self._uid] = f"{self._kind}@{self._site}"
 
     def acquire(self, blocking=True, timeout=-1):
+        _sched_yield()
         ok = self._inner.acquire(blocking, timeout)
         if ok:
             _note_acquired(self)
@@ -267,9 +329,12 @@ class _RLockProxy(_LockProxy):
     def acquire(self, blocking=True, timeout=-1):
         me = _thread.get_ident()
         if self._owner == me:
+            # Reentrant re-acquire: no perturbation (the owner cannot
+            # contend with itself) and no order edges.
             self._inner.acquire(blocking, timeout)
             self._count += 1
             return True
+        _sched_yield()
         ok = self._inner.acquire(blocking, timeout)
         if ok:
             self._owner = me
@@ -393,6 +458,20 @@ def install() -> None:
     socket.socket.connect = _connect
     threading.Thread.join = _join_wrapper(threading.Thread.join)
 
+    seed = os.environ.get("PILOSA_TPU_LOCKCHECK_SCHED")
+    if seed:
+        try:
+            n = int(seed)
+        except ValueError:
+            # Non-numeric value (someone treated the knob as a boolean
+            # toggle): derive a stable seed instead of crashing install()
+            # after the monkey-patches are already applied — the run
+            # stays deterministic for that spelling.
+            import zlib
+
+            n = zlib.crc32(seed.encode("utf-8"))
+        configure_sched(n)
+
 
 def uninstall() -> None:
     global _installed
@@ -409,6 +488,7 @@ def uninstall() -> None:
     os.rename = _orig["os.rename"]
     socket.socket.connect = _orig["socket.connect"]
     threading.Thread.join = _orig["Thread.join"]
+    configure_sched(None)
 
 
 def active() -> bool:

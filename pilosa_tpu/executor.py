@@ -1473,7 +1473,7 @@ class Executor:
         from .server.client import ClientError
 
         if not self.health.allow_request(node.id):
-            self.holder.stats.count("WriteForwardSkipped", 1)
+            self._count_stat("WriteForwardSkipped")
             errors.append(f"{node.id}{what}: unavailable (breaker open)")
             return None
         try:
@@ -1485,7 +1485,7 @@ class Executor:
                 errors.append(f"{node.id}: {e}")
                 return None
             self.health.record_failure(node.id)
-            self.holder.stats.count("WriteForwardFailed", 1)
+            self._count_stat("WriteForwardFailed")
             errors.append(f"{node.id}: {e}")
             return None
         self.health.record_success(node.id)
@@ -1785,7 +1785,7 @@ class Executor:
             if node.id == self.node.id:
                 continue
             if not self.health.allow_request(node.id):
-                self.holder.stats.count("WriteForwardSkipped", 1)
+                self._count_stat("WriteForwardSkipped")
                 continue
             try:
                 self.client.query_node(node, index, str(c), remote=True)
@@ -1798,7 +1798,7 @@ class Executor:
                     app_error = app_error or e
                     continue
                 self.health.record_failure(node.id)
-                self.holder.stats.count("WriteForwardFailed", 1)
+                self._count_stat("WriteForwardFailed")
             else:
                 self.health.record_success(node.id)
         if app_error is not None:

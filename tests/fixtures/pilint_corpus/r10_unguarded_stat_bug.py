@@ -1,0 +1,22 @@
+"""Reverted fix (PR 12 crash class): the write-forward fan-out counted
+breaker short-circuits straight through self.holder.stats — and
+library embedders run Holder(None), so the DEGRADED path (peer down,
+breaker open) crashed on the counter that was supposed to observe it."""
+
+
+class Executor:
+    def _forward_to_all(self, index, c, opt):
+        for node in self.cluster.nodes:
+            if node.id == self.node.id:
+                continue
+            if not self.health.allow_request(node.id):
+                self.holder.stats.count("WriteForwardSkipped", 1)
+                continue
+            try:
+                self.client.query_node(node, index, str(c), remote=True)
+            except Exception as e:
+                self.logger.error("forward failed: %s", e)
+                self.health.record_failure(node.id)
+                self.holder.stats.count("WriteForwardFailed", 1)
+            else:
+                self.health.record_success(node.id)

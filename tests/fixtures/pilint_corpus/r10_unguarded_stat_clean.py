@@ -1,0 +1,25 @@
+"""Clean twin of r10_unguarded_stat_bug: every stat rides the
+_count_stat guard, whose body is the dominating None-check — a
+stats-less holder skips the count instead of crashing the fan-out."""
+
+
+class Executor:
+    def _count_stat(self, name):
+        if self.holder.stats is not None:
+            self.holder.stats.count(name, 1)
+
+    def _forward_to_all(self, index, c, opt):
+        for node in self.cluster.nodes:
+            if node.id == self.node.id:
+                continue
+            if not self.health.allow_request(node.id):
+                self._count_stat("WriteForwardSkipped")
+                continue
+            try:
+                self.client.query_node(node, index, str(c), remote=True)
+            except Exception as e:
+                self.logger.error("forward failed: %s", e)
+                self.health.record_failure(node.id)
+                self._count_stat("WriteForwardFailed")
+            else:
+                self.health.record_success(node.id)

@@ -1,0 +1,14 @@
+"""Reverted fix (the config-plane drift R11 exists for, as shipped in
+this PR's own sweep): `plan_cache` was parseable from TOML, settable by
+env and flag — but absent from the to_toml dump and the subsystem doc,
+so a resolved config written back out silently DROPPED the knob and no
+operator could discover it. The test supplies a surface corpus missing
+exactly those two spellings."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class EngineConfig:
+    gather_workers: int = 0
+    plan_cache: int = 1

@@ -1,0 +1,11 @@
+"""Clean twin of r11_config_drift_bug: same dataclass, linted against a
+surface corpus that carries every spelling of both fields — parser,
+dump, env, flag mapping, CLI flag, and the subsystem doc."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class EngineConfig:
+    gather_workers: int = 0
+    plan_cache: int = 1

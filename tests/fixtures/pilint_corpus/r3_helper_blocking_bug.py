@@ -1,0 +1,27 @@
+"""Reverted fix (PR 8 review-round class): the demote commit holds the
+worker mutex while a helper persists state — the fsync and rename are
+one call deep, invisible to a lexical per-file rule, and every reader
+of this fragment's queue stalls behind the disk flush."""
+
+import os
+
+
+class DemoteWorker:
+    def commit(self, entry):
+        with self._mu:
+            self._queue.append(entry)
+            self._persist()
+            self._notify()
+
+    def _persist(self):
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self._encode())
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+
+    def _notify(self):
+        self._dirty = True
+
+    def _encode(self):
+        return "state"

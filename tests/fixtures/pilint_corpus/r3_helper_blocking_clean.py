@@ -1,0 +1,26 @@
+"""Clean twin of r3_helper_blocking_bug: capture under the mutex,
+persist after releasing it (the docs/tiered-storage.md split)."""
+
+import os
+
+
+class DemoteWorker:
+    def commit(self, entry):
+        with self._mu:
+            self._queue.append(entry)
+            payload = self._encode()
+            self._notify()
+        self._persist(payload)
+
+    def _persist(self, payload):
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+
+    def _notify(self):
+        self._dirty = True
+
+    def _encode(self):
+        return "state"

@@ -1,0 +1,16 @@
+"""Reverted fix (PR 9 round 5): the batched count dispatched through
+_device_call but materialized the device array OUTSIDE it. jax
+dispatches asynchronously, so a real device fault surfaces at the
+np.asarray — as a raw XlaRuntimeError that bypasses classification, the
+breakers, and the executor's fallback ladder entirely."""
+
+import numpy as np
+
+
+class Engine:
+    def count_batch(self, index, calls, shards):
+        sig = ("count_batch", len(calls), len(shards))
+        fn = self._fn_build(self._count_fns, sig, self._build)
+        leaves = self._leaf_tensor(index, calls, shards)
+        arr = self._device_call(sig, lambda: fn(leaves))
+        return np.asarray(arr)[: len(calls)]

@@ -1,0 +1,14 @@
+"""Clean twin of r8_unguarded_materialization_bug: materialize INSIDE
+the guard thunk, where a device fault is classified, recorded into the
+breakers, and re-raised typed for the executor's ladder."""
+
+import numpy as np
+
+
+class Engine:
+    def count_batch(self, index, calls, shards):
+        sig = ("count_batch", len(calls), len(shards))
+        fn = self._fn_build(self._count_fns, sig, self._build)
+        leaves = self._leaf_tensor(index, calls, shards)
+        return self._device_call(
+            sig, lambda: np.asarray(fn(leaves))[: len(calls)])

@@ -1,0 +1,42 @@
+"""Reverted fix (CollectivePlaneHealth.allow — the same claim-before-
+check bug as the device plane, shipped and fixed independently): the
+leader-side gate claimed the plane's half-open probe, then walked the
+participating slices and returned False on the first slice still inside
+its backoff. Every such short-circuit orphaned the plane probe, which
+expired as a failure — the plane's backoff doubled without a single
+real collective entry."""
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class CollectivePlaneHealth:
+    def allow(self, slices):
+        now = self.clock()
+        with self._mu:
+            gate = self._gate_locked(self._plane, now, "plane_probes",
+                                     "plane_short_circuits")
+            if gate is False:
+                return False
+            for p in slices:
+                s = self._slices.get(int(p))
+                if s is None or s.state == CLOSED:
+                    continue
+                g2 = self._gate_locked(s, now, "slice_probes",
+                                       "slice_short_circuits")
+                if g2 is False:
+                    # Plane probe (and earlier slices') already claimed.
+                    return False
+        return True
+
+    def _gate_locked(self, b, now, probes_key, short_key):
+        if b.state == CLOSED:
+            return None
+        if b.state == OPEN and now - b.opened_at >= b.backoff:
+            b.state = HALF_OPEN
+            b.probe_at = now
+            self.counters[probes_key] += 1
+            return True
+        self.counters[short_key] += 1
+        return False

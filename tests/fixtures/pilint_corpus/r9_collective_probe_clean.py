@@ -1,0 +1,51 @@
+"""Clean twin of r9_collective_probe_bug: two passes — a side-effect-
+free due check over EVERY breaker first, probe claims second (the
+shipped CollectivePlaneHealth.allow shape)."""
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class CollectivePlaneHealth:
+    def allow(self, slices):
+        now = self.clock()
+        with self._mu:
+            if not self._due_locked(self._plane, now):
+                self.counters["plane_short_circuits"] += 1
+                return False
+            open_slices = []
+            for p in slices:
+                s = self._slices.get(int(p))
+                if s is None or s.state == CLOSED:
+                    continue
+                if not self._due_locked(s, now):
+                    self.counters["slice_short_circuits"] += 1
+                    return False
+                open_slices.append(s)
+            gate = self._gate_locked(self._plane, now, "plane_probes",
+                                     "plane_short_circuits")
+            if gate is False:
+                return False
+            for s in open_slices:
+                self._gate_locked(s, now, "slice_probes",
+                                  "slice_short_circuits")
+        return True
+
+    def _due_locked(self, b, now):
+        if b.state == OPEN:
+            return now - b.opened_at >= b.backoff
+        if b.state == HALF_OPEN:
+            return now - b.probe_at >= self.base
+        return True
+
+    def _gate_locked(self, b, now, probes_key, short_key):
+        if b.state == CLOSED:
+            return None
+        if b.state == OPEN and now - b.opened_at >= b.backoff:
+            b.state = HALF_OPEN
+            b.probe_at = now
+            self.counters[probes_key] += 1
+            return True
+        self.counters[short_key] += 1
+        return False

@@ -1,0 +1,45 @@
+"""Reverted fix (DevicePlaneHealth.plan): with the plane breaker open
+and the query's signature also quarantined, the pre-fix gate claimed the
+PLANE's half-open probe first and only then discovered the signature was
+still inside its own backoff — short-circuiting to "host" with the probe
+already claimed. The orphaned probe expired as a failure and doubled the
+plane backoff from short-circuits alone."""
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class DevicePlaneHealth:
+    def plan(self, sig=None):
+        now = self.clock()
+        with self._mu:
+            s = self._sigs.get(sig) if sig is not None else None
+            if self._plane.state != CLOSED:
+                gate = self._gate_locked(self._plane, now, "plane_probes",
+                                         "plane_short_circuits")
+                if gate is False:
+                    return "host"
+                if s is not None and s.state != CLOSED:
+                    g2 = self._gate_locked(s, now, "sig_probes",
+                                           "sig_short_circuits")
+                    if g2 is False:
+                        # Plane probe already claimed: orphaned.
+                        return "host"
+                return "device"
+            if s is not None:
+                if self._gate_locked(s, now, "sig_probes",
+                                     "sig_short_circuits") is False:
+                    return "shard"
+        return "device"
+
+    def _gate_locked(self, b, now, probes_key, short_key):
+        if b.state == CLOSED:
+            return None
+        if b.state == OPEN and now - b.opened_at >= b.backoff:
+            b.state = HALF_OPEN
+            b.probe_at = now
+            self.counters[probes_key] += 1
+            return True
+        self.counters[short_key] += 1
+        return False

@@ -1,0 +1,51 @@
+"""Clean twin of r9_device_probe_bug: a side-effect-free _due_locked
+pass over every involved breaker runs BEFORE any probe is claimed, so a
+short-circuit can never orphan a claimed probe (the shipped
+DevicePlaneHealth.plan shape)."""
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class DevicePlaneHealth:
+    def plan(self, sig=None):
+        now = self.clock()
+        with self._mu:
+            s = self._sigs.get(sig) if sig is not None else None
+            if self._plane.state != CLOSED:
+                if (s is not None and s.state != CLOSED
+                        and not self._due_locked(s, now)):
+                    self.counters["plane_short_circuits"] += 1
+                    return "host"
+                gate = self._gate_locked(self._plane, now, "plane_probes",
+                                         "plane_short_circuits")
+                if gate is False:
+                    return "host"
+                if s is not None and s.state != CLOSED:
+                    self._gate_locked(s, now, "sig_probes",
+                                      "sig_short_circuits")
+                return "device"
+            if s is not None:
+                if self._gate_locked(s, now, "sig_probes",
+                                     "sig_short_circuits") is False:
+                    return "shard"
+        return "device"
+
+    def _due_locked(self, b, now):
+        if b.state == OPEN:
+            return now - b.opened_at >= b.backoff
+        if b.state == HALF_OPEN:
+            return now - b.probe_at >= self.base
+        return True
+
+    def _gate_locked(self, b, now, probes_key, short_key):
+        if b.state == CLOSED:
+            return None
+        if b.state == OPEN and now - b.opened_at >= b.backoff:
+            b.state = HALF_OPEN
+            b.probe_at = now
+            self.counters[probes_key] += 1
+            return True
+        self.counters[short_key] += 1
+        return False

@@ -201,6 +201,41 @@ def test_config_toml_roundtrip(tmp_path):
     assert cfg.cluster.replicas == Config().cluster.replicas
 
 
+def test_config_toml_dump_covers_every_parsed_knob(tmp_path):
+    """Regression (pilint R11's drift class — engine.plan-cache was
+    parseable from TOML but missing from the to_toml dump): flip EVERY
+    config field to a non-default value, dump, reload, and assert
+    nothing silently reverted. A knob dropped from the dump loses the
+    operator's setting on any resolved-config round trip."""
+    import dataclasses
+
+    def perturb(v):
+        if isinstance(v, bool):
+            return not v
+        if isinstance(v, int):
+            return v + 1
+        if isinstance(v, float):
+            return v + 0.5
+        if isinstance(v, str):
+            return v + "x"
+        if isinstance(v, list):
+            return list(v) + ["localhost:19999"]
+        return v
+
+    cfg = Config()
+    for f in dataclasses.fields(cfg):
+        section = getattr(cfg, f.name)
+        if dataclasses.is_dataclass(section):
+            for sf in dataclasses.fields(section):
+                setattr(section, sf.name, perturb(getattr(section, sf.name)))
+        else:
+            setattr(cfg, f.name, perturb(section))
+    p = tmp_path / "perturbed.toml"
+    p.write_text(cfg.to_toml())
+    back = Config.load(str(p))
+    assert dataclasses.asdict(back) == dataclasses.asdict(cfg)
+
+
 def test_generate_config(capsys):
     assert main(["generate-config"]) == 0
     out = capsys.readouterr().out

@@ -385,3 +385,50 @@ def test_write_fanout_replica_flap_converges(cluster3r, tmp_path):
         assert b0.getvalue() == bX.getvalue()
     finally:
         flapper2.close()
+
+
+def test_write_forward_counters_survive_statsless_holder():
+    """Regression (pilint R10, the PR 12 crash class): the write-forward
+    fan-out's breaker counters ride the _count_stat guard, so a
+    stats-less holder (Holder(None), library embedders) skips the count
+    instead of crashing the degraded path — pre-fix,
+    self.holder.stats.count raised AttributeError the moment a peer
+    failed or its breaker opened."""
+    from pilosa_tpu.cluster.node import Cluster, Node
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import ExecOptions, Executor
+    from pilosa_tpu.pql.parser import parse
+
+    nodes = [Node(id="n0"), Node(id="n1")]
+    cluster = Cluster(node=nodes[0], nodes=nodes, replica_n=1,
+                      hasher=ModHasher())
+
+    class FakeClient:
+        def __init__(self):
+            self.calls = 0
+
+        def query_node(self, node, index, query, shards=None, remote=True):
+            self.calls += 1
+            raise ClientError("boom", status=0)  # transport failure
+
+    holder = Holder(None)
+    holder.open()
+    assert holder.stats is None
+    client = FakeClient()
+    ex = Executor(holder, cluster=cluster, client=client, workers=0)
+    call = parse('SetRowAttrs(f, 1, x="y")').calls[0]
+
+    # Failed-forward path: WriteForwardFailed rides the guard.
+    ex._forward_to_all("fz", call, ExecOptions())
+    assert client.calls == 1
+    # Breaker now open: the skip path counts WriteForwardSkipped through
+    # the guard and issues zero connect attempts.
+    ex._forward_to_all("fz", call, ExecOptions())
+    assert client.calls == 1
+
+    # The single-target tolerant step takes the same guard on both arms.
+    errors = []
+    res = ex._forward_tolerant(nodes[1], lambda n: True, errors,
+                               lambda e: None)
+    assert res is None
+    assert errors and "breaker open" in errors[0]

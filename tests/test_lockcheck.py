@@ -300,13 +300,86 @@ class TestReports:
         assert lc.findings() == []
 
 
+# -------------------------------------------------- schedule perturbation
+
+
+@needs_own_install
+class TestSchedPerturbation:
+    """Opt-in seeded yields at acquire boundaries
+    (PILOSA_TPU_LOCKCHECK_SCHED): one global PRNG behind the checker
+    lock, so a fixed acquire sequence replays the exact same decision
+    sequence under the same seed."""
+
+    def _drive(self):
+        locks = [threading.Lock() for _ in range(4)]
+        for _ in range(40):
+            for lk in locks:
+                with lk:
+                    pass
+
+    def test_disarmed_by_default(self, lc):
+        self._drive()
+        assert lc.sched_trace() == []
+
+    def test_same_seed_replays_the_same_decisions(self, lc):
+        lc.configure_sched(42)
+        self._drive()
+        first = lc.sched_trace()
+        assert len(first) == 160
+        assert any(y for y, _ in first), "seed 42 yielded nowhere in 160 draws"
+        assert not all(y for y, _ in first)
+        lc.configure_sched(42)
+        self._drive()
+        assert lc.sched_trace() == first
+        lc.configure_sched(None)
+
+    def test_different_seed_different_decisions(self, lc):
+        lc.configure_sched(42)
+        self._drive()
+        first = lc.sched_trace()
+        lc.configure_sched(7)
+        self._drive()
+        assert lc.sched_trace() != first
+        lc.configure_sched(None)
+
+    def test_non_numeric_env_seed_does_not_crash_install(self, lc, monkeypatch):
+        # someone treats the knob as a boolean toggle: install() derives
+        # a stable seed instead of dying mid-patch with a ValueError
+        lc.uninstall()
+        monkeypatch.setenv("PILOSA_TPU_LOCKCHECK_SCHED", "on")
+        lc.install()
+        with threading.Lock():
+            pass
+        assert len(lc.sched_trace()) == 1
+        lc.configure_sched(None)
+
+    def test_yields_produce_no_findings(self, lc):
+        # the perturbation sleeps through the ORIGINAL time.sleep, so it
+        # must never self-report blocking-under-lock — even when a yield
+        # fires while another instrumented lock is held
+        lc.configure_sched(3)
+        outer = threading.Lock()
+        inner = [threading.Lock() for _ in range(4)]
+        with outer:
+            for _ in range(40):
+                for lk in inner:
+                    with lk:
+                        pass
+        assert any(y for y, _ in lc.sched_trace())
+        assert lc.findings() == []
+        lc.configure_sched(None)
+
+
 # ------------------------------------------------------- enforcement runs
 
 
-def _run_instrumented(test_args, out_path, timeout, allow_test_failures=False):
+def _run_instrumented(test_args, out_path, timeout, allow_test_failures=False,
+                      sched_seed=None):
     env = dict(os.environ)
     env["PILOSA_TPU_LOCKCHECK"] = "1"
     env["PILOSA_TPU_LOCKCHECK_OUT"] = str(out_path)
+    if sched_seed is not None:
+        env["PILOSA_TPU_LOCKCHECK_SCHED"] = str(sched_seed)
     env.setdefault("JAX_PLATFORMS", "cpu")
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
@@ -337,6 +410,11 @@ def test_instrumented_smoke_chaos_tier_rebalance(tmp_path):
         ["tests/test_chaos.py", "tests/test_tier.py",
          "tests/test_rebalance.py", "tests/test_device_faults.py"],
         tmp_path / "lockcheck.json", timeout=600,
+        # Seeded schedule perturbation (tiny randomized yields at every
+        # lock-acquire boundary): the chaos smokes explore interleavings
+        # the OS scheduler would rarely pick, deterministically
+        # replayable via PILOSA_TPU_LOCKCHECK_SCHED=1337.
+        sched_seed=1337,
     )
     assert payload["count"] == 0, json.dumps(payload["findings"], indent=2)
 

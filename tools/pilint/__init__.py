@@ -1,31 +1,48 @@
 """pilint: project-specific invariant lint for pilosa-tpu.
 
-Seven PRs of review notes distilled into machine-checkable rules
-(docs/static-analysis.md has the full contract):
+Review notes from a dozen PRs distilled into machine-checkable rules
+(docs/static-analysis.md has the full contract). v2 is interprocedural:
+a per-module call graph (tools/pilint/graph.py) with a config-bounded
+depth limit backs R3/R5/R8/R9, so a bug one call deep is no longer
+invisible.
 
-  R1 swallowed-exceptions   broad `except Exception` handlers must log,
-                            count, capture, or re-raise; broad guards
-                            around imports must catch ImportError.
-  R2 jax-free-zones         config-surface modules stay importable
-                            without jax (no module-level jax imports).
-  R3 blocking-under-lock    no deny-listed blocking call (sleep, fsync,
-                            socket/HTTP send, device_put, engine gather)
-                            lexically inside a `with <lock>:` block.
-  R4 counter-hygiene        every literal-keyed counter increment is
-                            reachable from /debug/vars (a wholesale
-                            `snapshot()` export or an explicit literal in
-                            handler.py/diagnostics.py).
-  R5 mutation-epoch-audit   core/ methods that mutate bitmap storage
-                            must reach a generation/epoch bump through
-                            the same-class call graph.
+  R1  swallowed-exceptions   broad `except Exception` handlers must log,
+                             count, capture, or re-raise; broad guards
+                             around imports must catch ImportError.
+  R2  jax-free-zones         config-surface modules stay importable
+                             without jax (no module-level jax imports).
+  R3  blocking-under-lock    no deny-listed blocking call (sleep, fsync,
+                             socket/HTTP send, device_put, engine gather)
+                             inside a `with <lock>:` block — directly OR
+                             through resolved callees (lock-flow).
+  R4  counter-hygiene        every literal-keyed counter increment is
+                             reachable from /debug/vars (a wholesale
+                             `snapshot()` export or an explicit literal in
+                             handler.py/diagnostics.py).
+  R5  mutation-epoch-audit   core/ methods that mutate bitmap storage
+                             must reach a generation/epoch bump through
+                             the same-class call graph.
+  R6  failpoint-hygiene      fire sites documented; test activation
+                             specs name real fire sites.
+  R7  span-hygiene           recorder span names documented; trace
+                             assertions name real recording sites.
+  R8  guarded-materialization device results force to host inside the
+                             _device_call/ladder guard (engine/collective).
+  R9  probe-claim-hygiene    multi-breaker probe claims are dominated by
+                             a side-effect-free due check (health modules).
+  R10 none-guarded-stats     stat sites survive stats-less holders
+                             (route through _count_stat-style guards).
+  R11 config-surface         every section *Config field reaches TOML
+                             parse + dump, env, CLI flag, and its doc.
 
-Escape hatch: `# pilint: allow-<rule>(<reason>)` on the flagged line or
+Escape hatch: `# pilint: allow-<kind>(<reason>)` on the flagged line or
 the line above, with a mandatory human-readable reason. Unknown kinds,
 empty reasons, and annotations that suppress nothing are themselves
 violations, so the allow-list cannot rot silently.
 
-Run: `python -m tools.pilint pilosa_tpu/` (exit 1 on violations).
-Stdlib `ast` only — no third-party dependencies.
+Run: `python -m tools.pilint pilosa_tpu/` (exit 1 on violations);
+`--changed [REF]` for the incremental mode, `--depth N` for the
+interprocedural limit. Stdlib `ast` only — no third-party dependencies.
 """
 
 from .core import Violation, Annotation, parse_annotations

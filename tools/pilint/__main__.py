@@ -1,15 +1,20 @@
 """CLI: `python -m tools.pilint pilosa_tpu/ [more paths] [--rule R1,R3]`.
 
 Exit status: 0 clean, 1 violations, 2 usage error. Run from the repo
-root (or pass --root) so zone/wiring paths resolve.
+root (or pass --root) so zone/wiring paths resolve. `--changed [REF]`
+lints only files changed relative to REF (default HEAD) plus untracked
+files — the pre-commit-cheap incremental mode; cross-file corpora (R6,
+R7, R11) are still gathered from the full tree.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
-from .runner import format_report, lint_paths
+from .graph import DEFAULT_DEPTH
+from .runner import changed_files, format_report, lint_paths
 from .rules import ALL_RULES
 
 
@@ -24,6 +29,13 @@ def main(argv=None) -> int:
                         "(disables the unused-annotation check)")
     parser.add_argument("--root", default=None,
                         help="repo root for relative-path rules (default: cwd)")
+    parser.add_argument("--depth", type=int, default=DEFAULT_DEPTH,
+                        help="interprocedural call-depth limit for the "
+                        f"dataflow rules (default: {DEFAULT_DEPTH})")
+    parser.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                        metavar="REF",
+                        help="lint only files in `git diff --name-only REF` "
+                        "(default REF: HEAD) plus untracked .py files")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -32,7 +44,10 @@ def main(argv=None) -> int:
             print(f"{rule_id}  {fn.__name__.removeprefix('rule_')}")
         return 0
 
-    paths = args.paths or ["pilosa_tpu"]
+    if args.depth < 1:
+        print("--depth must be >= 1", file=sys.stderr)
+        return 2
+
     rules = None
     if args.rule:
         rules = [r.strip().upper() for r in args.rule.split(",") if r.strip()]
@@ -42,7 +57,25 @@ def main(argv=None) -> int:
             print(f"unknown rule(s): {', '.join(bad)}", file=sys.stderr)
             return 2
 
-    violations = lint_paths(paths, repo_root=args.root, rules=rules)
+    root = args.root or os.getcwd()
+    if args.changed is not None:
+        if args.paths:
+            print("--changed and explicit paths are mutually exclusive",
+                  file=sys.stderr)
+            return 2
+        try:
+            paths = changed_files(args.changed, root)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        if not paths:
+            print("pilint: 0 violations (no changed .py files)")
+            return 0
+    else:
+        paths = args.paths or ["pilosa_tpu"]
+
+    violations = lint_paths(paths, repo_root=args.root, rules=rules,
+                            depth=args.depth)
     print(format_report(violations))
     return 1 if violations else 0
 

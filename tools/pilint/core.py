@@ -14,9 +14,11 @@ below it (so it can ride above a statement too long to share a line).
 from __future__ import annotations
 
 import ast
+import io
 import re
+import tokenize
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 # One kind per rule that supports suppression. R2 (jax-free zones) has no
 # escape hatch on purpose: a jax import in a config module is never
@@ -27,8 +29,14 @@ from typing import Dict, List, Optional, Tuple
 # "span" mirrors it for R7: on a recording site it excuses a span name
 # kept out of docs/observability.md's table, and in a TEST file it marks
 # a deliberately-bogus asserted name (fixture negatives) as not-a-typo.
+# pilint v2 kinds: "blocking" now also vouches for a CALL SITE inside a
+# lock region (the caller takes responsibility for the callee subtree,
+# matching the runtime checker's any-frame suppression); "materialize"
+# excuses an R8 forcing site, "probe" an R9 claim site, "stat" an R10
+# unguarded stat site, "config" an R11 dataclass field kept off part of
+# the config surface.
 KNOWN_KINDS = ("swallow", "blocking", "counter", "mutation", "failpoint",
-               "span")
+               "span", "materialize", "probe", "stat", "config")
 
 _ANNOT_RE = re.compile(
     r"#\s*pilint:\s*allow-(?P<kind>[a-z][a-z-]*)\((?P<reason>[^)]*)\)"
@@ -62,14 +70,24 @@ class Annotation:
 
 @dataclass
 class FileContext:
-    """Everything a rule needs about one file, parsed once."""
+    """Everything a rule needs about one file, parsed once.
+
+    v2 also hosts the shared walk caches: rules used to each re-walk the
+    whole tree (7+ full walks per file); `nodes()` materializes one walk
+    every rule iterates, `parents()` one child->parent map (guard-context
+    checks), `graph()` one call graph (the interprocedural rules). The
+    AST itself is parsed exactly once by the runner and shared here."""
 
     path: str  # repo-relative, forward slashes
     source: str
     tree: ast.AST
     annotations: List[Annotation] = field(default_factory=list)
+    depth: int = 0  # interprocedural depth limit; 0 = runner default
     # line -> annotations covering that line (own line + line below)
     _by_line: Dict[int, List[Annotation]] = field(default_factory=dict)
+    _nodes: Optional[List[ast.AST]] = field(default=None, repr=False)
+    _parents: Optional[Dict[ast.AST, ast.AST]] = field(default=None, repr=False)
+    _graph: object = field(default=None, repr=False)
 
     def __post_init__(self) -> None:
         for a in self.annotations:
@@ -85,16 +103,69 @@ class FileContext:
                 return True
         return False
 
+    def nodes(self) -> List[ast.AST]:
+        """One full walk of the tree, materialized once and shared by
+        every rule that previously re-walked it."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        """child -> parent map over the whole tree, built once."""
+        if self._parents is None:
+            self._parents = {
+                child: node for node in self.nodes()
+                for child in ast.iter_child_nodes(node)
+            }
+        return self._parents
+
+    def graph(self):
+        """The module call graph (tools/pilint/graph.py), built once and
+        shared by the interprocedural rules (R3, R5, R8, R9)."""
+        if self._graph is None:
+            from .graph import ModuleGraph
+
+            self._graph = ModuleGraph(self.tree)
+        return self._graph
+
+    def call_span_lines(self) -> Set[int]:
+        """Every source line covered by some Call node — the runtime lock
+        checker can only ever blame lines a call crosses, so an
+        allow-blocking annotation covering none is provably rot."""
+        lines: Set[int] = set()
+        for node in self.nodes():
+            if isinstance(node, ast.Call):
+                end = getattr(node, "end_lineno", None) or node.lineno
+                lines.update(range(node.lineno, end + 1))
+        return lines
+
+
+def _comment_lines(source: str) -> Optional[List[Tuple[int, str]]]:
+    """(lineno, text) for every actual COMMENT token, so an annotation
+    spelled inside a docstring or string literal (lockcheck.py documents
+    the grammar in prose) is never parsed as a live annotation. None on
+    tokenize failure — caller falls back to the raw-line scan."""
+    try:
+        out = [(tok.start[0], tok.string)
+               for tok in tokenize.generate_tokens(io.StringIO(source).readline)
+               if tok.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+    return out
+
 
 def parse_annotations(path: str, source: str) -> Tuple[List[Annotation], List[Violation]]:
-    """Extract annotations and grammar violations from raw source.
+    """Extract annotations and grammar violations from comment tokens.
 
     Grammar violations (A0): unknown kind, missing/too-short reason. A
     malformed annotation is still RECORDED so the rule it meant to
     suppress stays suppressed — one finding per problem, not two."""
     annotations: List[Annotation] = []
     violations: List[Violation] = []
-    for lineno, text in enumerate(source.splitlines(), start=1):
+    lines = _comment_lines(source)
+    if lines is None:
+        lines = list(enumerate(source.splitlines(), start=1))
+    for lineno, text in lines:
         for m in _ANNOT_RE.finditer(text):
             kind, reason = m.group("kind"), m.group("reason").strip()
             annotations.append(Annotation(line=lineno, kind=kind, reason=reason))
@@ -117,20 +188,35 @@ def unused_annotation_violations(ctx: FileContext) -> List[Violation]:
     """Annotations that suppressed nothing are stale and must go — a rot
     check run AFTER all rules so `used` flags are final.
 
-    `allow-blocking` is exempt: the runtime lock checker
-    (pilosa_tpu/devtools/lockcheck.py) consumes the same grammar for
-    calls that only BECOME lock-held dynamically (an fsync inside a
-    helper its caller locks around), which this static pass can't see."""
+    `allow-blocking` keeps a NARROWED exemption: the runtime lock checker
+    (pilosa_tpu/devtools/lockcheck.py) consumes the same grammar and
+    honors the annotation on ANY frame of a blocking stack — so one that
+    suppressed no static finding may still be load-bearing at runtime,
+    but only if a call actually crosses a line it covers. A blocking
+    annotation covering no call at all can never match a runtime frame
+    either: that is rot from a refactor that moved the call, flag it."""
     out = []
+    call_lines: Optional[Set[int]] = None
     for a in ctx.annotations:
-        if a.kind == "blocking":
+        if a.kind not in KNOWN_KINDS or len(a.reason) < MIN_REASON or a.used:
             continue
-        if a.kind in KNOWN_KINDS and len(a.reason) >= MIN_REASON and not a.used:
+        if a.kind == "blocking":
+            if call_lines is None:
+                call_lines = ctx.call_span_lines()
+            if a.line in call_lines or a.line + 1 in call_lines:
+                continue  # runtime-consumable: a call crosses its lines
             out.append(Violation(
                 ctx.path, a.line, "A0", "annotation-grammar",
-                f"unused allow-{a.kind} annotation (nothing on this line "
-                "or the line below triggers that rule) — delete it",
+                "unused allow-blocking annotation (no call crosses this "
+                "line or the line below, so neither the static pass nor "
+                "the runtime lock checker can ever consume it) — delete it",
             ))
+            continue
+        out.append(Violation(
+            ctx.path, a.line, "A0", "annotation-grammar",
+            f"unused allow-{a.kind} annotation (nothing on this line "
+            "or the line below triggers that rule) — delete it",
+        ))
     return out
 
 

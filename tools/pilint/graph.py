@@ -1,0 +1,208 @@
+"""Module-level call graph + interprocedural helpers (pilint v2).
+
+PR 8's rules were lexical and per-file: anything one call deep was
+invisible, and PRs 9/12 each paid multiple review rounds for the same
+bug classes hiding in helpers (an fsync inside a method its caller locks
+around, a device result materialized in a callee of the guard). This
+module is the shared foundation the interprocedural rules (R3, R5, R8,
+R9) stand on:
+
+- ``ModuleGraph``: every function/method/nested-def in one module as a
+  node, with call edges resolved conservatively — ``self.m()`` /
+  ``cls.m()`` to the enclosing class's method, bare names to a nested
+  def of an enclosing function or a module-level function. Unresolvable
+  calls (other objects, imports) are simply absent: the analysis is
+  may-analysis over what it can see, never a guess.
+- may-hold-lock propagation: starting from ``with <lock>:`` bodies,
+  walk resolved edges up to a config-bounded depth so a helper that
+  blocks under its caller's lock is attributed with the full call chain.
+
+The depth limit (``DEFAULT_DEPTH``, CLI ``--depth``) bounds every walk;
+cycles terminate via a best-depth visited map regardless.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import terminal_name
+
+# One knob for every interprocedural walk (R3 lock-flow, R5 bump reach,
+# R8 guard domination). Four call edges covers the deepest real chain in
+# the tree with one level of headroom; a helper nest deeper than that is
+# its own code smell.
+DEFAULT_DEPTH = 4
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SKIP_BODY = _FUNC_NODES + (ast.Lambda,)
+
+
+@dataclass
+class CallSite:
+    """One call inside a function's own body (nested defs excluded —
+    their bodies run when *they* are called, which is its own edge)."""
+
+    node: ast.Call
+    lineno: int
+    callee: Optional[str]  # resolved qualname, or None (out of scope)
+
+
+@dataclass
+class FuncNode:
+    qualname: str  # "Cls.meth", "func", "Cls.meth.<nested>"
+    name: str
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    cls: Optional[str]  # enclosing class name, if a method
+    parent: Optional[str]  # enclosing function qualname, if nested
+    calls: List[CallSite] = field(default_factory=list)
+    nested: Dict[str, str] = field(default_factory=dict)  # name -> qualname
+
+
+def own_body_walk(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Yield every node of a function's OWN body: nested function and
+    lambda bodies are pruned (they execute at their call sites, not
+    here — the graph models that with explicit edges)."""
+    todo = list(ast.iter_child_nodes(fn_node))
+    while todo:
+        node = todo.pop()
+        if isinstance(node, _SKIP_BODY):
+            continue
+        yield node
+        todo.extend(ast.iter_child_nodes(node))
+
+
+class ModuleGraph:
+    """Call graph over one module's AST. Built once per FileContext and
+    shared by every rule that needs callee reach."""
+
+    def __init__(self, tree: ast.AST):
+        self.functions: Dict[str, FuncNode] = {}
+        self.methods_of: Dict[str, Dict[str, str]] = {}  # cls -> name -> qual
+        self.module_funcs: Dict[str, str] = {}  # name -> qualname
+        self._collect(tree.body, cls=None, parent=None)
+        for fn in self.functions.values():
+            self._resolve_calls(fn)
+
+    # ------------------------------------------------------------ building
+
+    def _collect(self, body, cls: Optional[str], parent: Optional[str]) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                # Class bodies reset the function-nesting context: a
+                # method of a nested class is that class's method.
+                self._collect(node.body, cls=node.name, parent=None)
+            elif isinstance(node, _FUNC_NODES):
+                qual = (f"{parent}.{node.name}" if parent
+                        else f"{cls}.{node.name}" if cls else node.name)
+                fn = FuncNode(qualname=qual, name=node.name, node=node,
+                              cls=cls, parent=parent)
+                self.functions[qual] = fn
+                if parent is None and cls is not None:
+                    self.methods_of.setdefault(cls, {})[node.name] = qual
+                elif parent is None and cls is None:
+                    self.module_funcs[node.name] = qual
+                else:
+                    p = self.functions.get(parent)
+                    if p is not None:
+                        p.nested[node.name] = qual
+                self._collect(node.body, cls=cls, parent=qual)
+            else:
+                # Compound statements can hide defs at ANY nesting depth
+                # (an except-handler's fallback def, an if inside a try);
+                # descend to each def/class boundary and recurse — the
+                # import-fallback idiom is exactly where blocking host
+                # helpers live.
+                todo = list(ast.iter_child_nodes(node))
+                while todo:
+                    sub = todo.pop()
+                    if isinstance(sub, (ast.ClassDef,) + _FUNC_NODES):
+                        self._collect([sub], cls=cls, parent=parent)
+                        continue
+                    if isinstance(sub, ast.Lambda):
+                        continue
+                    todo.extend(ast.iter_child_nodes(sub))
+
+    def _resolve_calls(self, fn: FuncNode) -> None:
+        for node in own_body_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn.calls.append(CallSite(
+                node=node, lineno=node.lineno,
+                callee=self.resolve(fn, node)))
+
+    def resolve(self, fn: FuncNode, call: ast.Call) -> Optional[str]:
+        """Conservative callee resolution inside `fn`; None when the
+        target is outside this module's static view."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id in ("self", "cls") and fn.cls is not None:
+                return self.methods_of.get(fn.cls, {}).get(f.attr)
+            return None
+        if isinstance(f, ast.Name):
+            # Innermost-first lexical scope: nested defs of the enclosing
+            # function chain, then module-level functions.
+            cur: Optional[FuncNode] = fn
+            while cur is not None:
+                if f.id in cur.nested:
+                    return cur.nested[f.id]
+                cur = self.functions.get(cur.parent) if cur.parent else None
+            return self.module_funcs.get(f.id)
+        return None
+
+    # ----------------------------------------------------------- lock flow
+
+    def lock_regions(self, is_lock) -> List[Tuple[FuncNode, ast.With, str]]:
+        """Every `with <lock>:` statement, paired with the function whose
+        body it sits in (resolution context for calls inside it)."""
+        out: List[Tuple[FuncNode, ast.With, str]] = []
+        for fn in self.functions.values():
+            for node in own_body_walk(fn.node):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        if is_lock(item.context_expr):
+                            name = terminal_name(item.context_expr) or "<lock>"
+                            out.append((fn, node, name))
+                            break
+        return out
+
+    def reach(self, seeds: List[Tuple[str, int, str]], depth_limit: int,
+              follow_edge=None):
+        """May-reach walk: from `seeds` [(qualname, seed_lineno, label)],
+        yield (FuncNode, depth, chain) for every function reachable
+        within `depth_limit` call edges. `chain` is the human-readable
+        call path ("with self._mu (line 10) -> self._flush (line 12)").
+        `follow_edge(call_site) -> bool` can veto an edge (annotation
+        vouching). Cycles terminate via a best-depth visited map."""
+        best: Dict[str, int] = {}
+        todo = [(qual, 1, label) for qual, _line, label in seeds]
+        while todo:
+            qual, depth, chain = todo.pop(0)
+            if depth > depth_limit:
+                continue
+            fn = self.functions.get(qual)
+            if fn is None or best.get(qual, depth_limit + 1) <= depth:
+                continue
+            best[qual] = depth
+            yield fn, depth, chain
+            for site in fn.calls:
+                if site.callee is None:
+                    continue
+                if follow_edge is not None and not follow_edge(site):
+                    continue
+                todo.append((
+                    site.callee, depth + 1,
+                    f"{chain} -> {_callee_label(site)} (line {site.lineno})"))
+
+    # ------------------------------------------------------------- queries
+
+    def class_methods(self, cls: str) -> Dict[str, str]:
+        return self.methods_of.get(cls, {})
+
+
+def _callee_label(site: CallSite) -> str:
+    f = site.node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f"{f.value.id}.{f.attr}"
+    return terminal_name(f) or "<call>"

@@ -1,8 +1,11 @@
-"""The five pilint rules. Each rule is a function(ctx, env) -> [Violation].
+"""The pilint rules (R1-R11). Each rule is a function(ctx, env) -> [Violation].
 
 `env` is a RepoEnv carrying the cross-file facts some rules need (R4's
-/debug/vars wiring corpus). Rules are pure AST walks — no imports of the
-linted code, so a file with a missing optional dependency still lints.
+/debug/vars wiring corpus, R6/R7's docs+site corpora, R11's config
+surface). Rules are pure AST walks over shared caches — no imports of
+the linted code, so a file with a missing optional dependency still
+lints; the interprocedural rules (R3, R5, R8, R9) additionally share
+the per-module call graph from tools/pilint/graph.py.
 """
 
 from __future__ import annotations
@@ -10,10 +13,11 @@ from __future__ import annotations
 import ast
 import re
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from .core import (FileContext, Violation, dotted_name, parse_annotations,
                    terminal_name)
+from .graph import DEFAULT_DEPTH, ModuleGraph, own_body_walk
 
 # --------------------------------------------------------------------------
 # cross-file environment
@@ -62,6 +66,25 @@ class RepoEnv:
     span_docs_loaded: bool = False
     span_record_sites: Set[str] = field(default_factory=set)
     span_assert_sites: List = field(default_factory=list)
+    # R11 (config-surface completeness): every string constant in
+    # config.py (TOML keys, env spellings, flag-mapping keys, to_toml
+    # dump lines — f-string constant parts included) and cli.py (flag
+    # spellings), plus the text of each section's reference doc. The
+    # rule no-ops until config_surface_loaded so fixture runs that lint
+    # a lone dataclass snippet without the corpus never false-positive.
+    config_surface_loaded: bool = False
+    config_constants: Set[str] = field(default_factory=set)
+    cli_constants: Set[str] = field(default_factory=set)
+    config_docs: Dict[str, str] = field(default_factory=dict)
+    # Per-SECTION scoping for the parse/dump halves: a TOML key shared
+    # by two sections (`delta-max-fraction` in [engine] and
+    # [collective], `key` in [gossip] and [tls]) must not let one
+    # section's spelling mask the other's drift. config_set_attrs holds
+    # every dotted attribute-store chain in config.py (the _apply_dict
+    # parse surface, `self.engine.plan_cache = ...`); config_dump_rows
+    # maps a to_toml section header to the row constants inside it.
+    config_set_attrs: Set[str] = field(default_factory=set)
+    config_dump_rows: Dict[str, Set[str]] = field(default_factory=dict)
 
 
 WIRING_FILES = ("pilosa_tpu/server/handler.py", "pilosa_tpu/diagnostics.py")
@@ -148,7 +171,7 @@ def _try_body_imports(handler: ast.ExceptHandler, tree: ast.AST) -> bool:
 
 def rule_swallow(ctx: FileContext, env: RepoEnv) -> List[Violation]:
     out: List[Violation] = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes():
         if not isinstance(node, ast.ExceptHandler) or not _is_broad(node):
             continue
         if _try_body_imports(node, ctx.tree):
@@ -293,50 +316,104 @@ def _deny_match(call: ast.Call) -> Optional[str]:
     return None
 
 
-def rule_blocking_under_lock(ctx: FileContext, env: RepoEnv) -> List[Violation]:
-    out: List[Violation] = []
-
-    def _scan_node(node: ast.AST) -> None:
-        """Walk a statement inside a held-lock region, pruning nested
-        function/lambda bodies (they run later, lock not necessarily
-        held)."""
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            return
+def _region_calls(stmts) -> List[ast.Call]:
+    """Every call lexically inside a held-lock region, pruning nested
+    function/lambda bodies (they run later, lock not necessarily held)."""
+    out: List[ast.Call] = []
+    todo = list(stmts)
+    while todo:
+        node = todo.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
         if isinstance(node, ast.Call):
-            hit = _deny_match(node)
-            if hit and not ctx.allowed(node.lineno, "blocking"):
-                out.append(Violation(
-                    ctx.path, node.lineno, "R3", "blocking-under-lock",
-                    f"blocking call `{hit}` inside a `with <lock>:` block — "
-                    "serialize off-lock (docs/durability.md, "
-                    "docs/tiered-storage.md) or annotate "
-                    "`# pilint: allow-blocking(reason)`",
-                ))
-        for child in ast.iter_child_nodes(node):
-            _scan_node(child)
+            out.append(node)
+        todo.extend(ast.iter_child_nodes(node))
+    return out
 
-    def visit(node: ast.AST) -> None:
-        if isinstance(node, ast.With) and any(
-                _is_lock_name(item.context_expr) for item in node.items):
-            for stmt in node.body:
-                _scan_node(stmt)
-            # nested withs inside are re-visited below, which is fine:
-            # the outer scan already reported their bodies' direct calls,
-            # and allowed() marks by line so duplicates collapse.
-        for child in ast.iter_child_nodes(node):
-            visit(child)
 
-    visit(ctx.tree)
-    # de-duplicate (nested lock-withs make the outer and inner visit both
-    # report the same call)
-    seen: Set[tuple] = set()
-    unique = []
-    for v in out:
-        k = (v.line, v.message)
-        if k not in seen:
-            seen.add(k)
-            unique.append(v)
-    return unique
+def rule_blocking_under_lock(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    """R3, interprocedural since pilint v2: the lexical half flags
+    deny-listed calls directly inside a `with <lock>:` block; the
+    dataflow half propagates the may-hold-lock fact through resolved
+    same-class / module-function call edges (depth-bounded), so a helper
+    that fsyncs or sleeps under its CALLER's lock is caught with the
+    full chain — the PR 8/9 review-round class the per-file rule missed.
+    An `allow-blocking` annotation on a call site inside the region
+    vouches for the whole callee subtree, mirroring the runtime
+    checker's any-frame suppression."""
+    out: List[Violation] = []
+    reported: Set[int] = set()
+    graph = ctx.graph()
+    depth_limit = ctx.depth or DEFAULT_DEPTH
+
+    def flag(call: ast.Call, hit: str, how: str) -> None:
+        if call.lineno in reported:
+            return
+        if ctx.allowed(call.lineno, "blocking"):
+            return
+        reported.add(call.lineno)
+        out.append(Violation(
+            ctx.path, call.lineno, "R3", "blocking-under-lock",
+            f"blocking call `{hit}` {how} — serialize off-lock "
+            "(docs/durability.md, docs/tiered-storage.md) or annotate "
+            "`# pilint: allow-blocking(reason)`",
+        ))
+
+    seeds: List[Tuple[str, int, str]] = []
+    seen_regions: Set[int] = set()
+    for fn, with_node, lock_name in graph.lock_regions(_is_lock_name):
+        if id(with_node) in seen_regions:
+            continue
+        seen_regions.add(id(with_node))
+        region = f"`with {lock_name}:` (line {with_node.lineno})"
+        for call in _region_calls(with_node.body):
+            hit = _deny_match(call)
+            if hit:
+                flag(call, hit, "inside a `with <lock>:` block")
+            callee = graph.resolve(fn, call)
+            if callee is not None and not ctx.allowed(call.lineno, "blocking"):
+                label = dotted_name(call.func) or terminal_name(call.func)
+                seeds.append((callee, call.lineno,
+                              f"{region} -> {label} (line {call.lineno})"))
+    # Module-level / class-body lock regions (outside any function) get
+    # the direct lexical scan AND seed the walk for bare-name calls to
+    # module functions — the graph's lock_regions only walks function
+    # bodies, and a `with _boot_lock: _warm()` helper must not hide.
+    for node in ctx.nodes():
+        if (isinstance(node, (ast.With, ast.AsyncWith))
+                and id(node) not in seen_regions
+                and any(_is_lock_name(i.context_expr) for i in node.items)):
+            lock_name = next(
+                (terminal_name(i.context_expr) for i in node.items
+                 if _is_lock_name(i.context_expr)), "<lock>")
+            region = f"`with {lock_name}:` (line {node.lineno})"
+            for call in _region_calls(node.body):
+                hit = _deny_match(call)
+                if hit:
+                    flag(call, hit, "inside a `with <lock>:` block")
+                if (isinstance(call.func, ast.Name)
+                        and call.func.id in graph.module_funcs
+                        and not ctx.allowed(call.lineno, "blocking")):
+                    seeds.append((graph.module_funcs[call.func.id],
+                                  call.lineno,
+                                  f"{region} -> {call.func.id} "
+                                  f"(line {call.lineno})"))
+
+    def follow(site) -> bool:
+        # The caller can vouch for a callee subtree with an annotation
+        # on the call-site line (the runtime checker honors any frame).
+        return not ctx.allowed(site.lineno, "blocking")
+
+    for fnode, _depth, chain in graph.reach(seeds, depth_limit, follow):
+        for node in own_body_walk(fnode.node):
+            if isinstance(node, ast.Call):
+                hit = _deny_match(node)
+                if hit:
+                    flag(node, hit,
+                         f"reached while a lock is held: {chain} -> "
+                         f"`{fnode.name}` blocks at line {node.lineno}")
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -518,7 +595,7 @@ def rule_failpoint_hygiene(ctx: FileContext, env: RepoEnv) -> List[Violation]:
     if not ctx.path.startswith("pilosa_tpu/") or not env.failpoint_docs_loaded:
         return []
     out: List[Violation] = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes():
         if not (isinstance(node, ast.Call)
                 and terminal_name(node.func) == "fire" and node.args
                 and isinstance(node.args[0], ast.Constant)
@@ -642,7 +719,7 @@ def rule_span_hygiene(ctx: FileContext, env: RepoEnv) -> List[Violation]:
     if not ctx.path.startswith("pilosa_tpu/") or not env.span_docs_loaded:
         return []
     out: List[Violation] = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes():
         if not isinstance(node, ast.Call):
             continue
         name = _span_call_name(node)
@@ -714,30 +791,35 @@ def _method_facts(fn: ast.FunctionDef):
 
 
 def rule_mutation_epoch(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    """R5, on the shared call graph since pilint v2: the bump-reach walk
+    uses the same class/method tables and config-bounded depth limit as
+    the other interprocedural rules instead of its own ad-hoc recursion
+    (facts still walk full method bodies, nested defs included — a bump
+    inside a worker closure the method spawns still counts)."""
     if "core/" not in ctx.path:
         return []
     out: List[Violation] = []
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.ClassDef):
-            continue
-        methods = {m.name: m for m in node.body
-                   if isinstance(m, ast.FunctionDef)}
-        facts = {name: _method_facts(fn) for name, fn in methods.items()}
+    graph = ctx.graph()
+    depth_limit = ctx.depth or DEFAULT_DEPTH
+    for cls, methods in graph.methods_of.items():
+        nodes = {name: graph.functions[qual].node
+                 for name, qual in methods.items()}
+        facts = {name: _method_facts(fn) for name, fn in nodes.items()}
 
-        def reaches_bump(name: str, seen: Set[str]) -> bool:
-            if name in seen or name not in facts:
+        def reaches_bump(name: str, depth: int, seen: Set[str]) -> bool:
+            if name in seen or name not in facts or depth > depth_limit:
                 return False
             seen.add(name)
             _, bumps, callees = facts[name]
             if bumps:
                 return True
-            return any(reaches_bump(c, seen) for c in callees)
+            return any(reaches_bump(c, depth + 1, seen) for c in callees)
 
-        for name, fn in methods.items():
+        for name, fn in nodes.items():
             mutates, _, _ = facts[name]
             if not mutates:
                 continue
-            if reaches_bump(name, set()):
+            if reaches_bump(name, 0, set()):
                 continue
             if ctx.allowed(fn.lineno, "mutation"):
                 continue
@@ -751,6 +833,724 @@ def rule_mutation_epoch(ctx: FileContext, env: RepoEnv) -> List[Violation]:
     return out
 
 
+# --------------------------------------------------------------------------
+# R8: guarded device materialization (parallel/engine.py, collective.py)
+
+
+# Files the rule judges: the two modules that dispatch device programs.
+R8_FILES = ("pilosa_tpu/parallel/engine.py",
+            "pilosa_tpu/parallel/collective.py")
+# Calls that return a compiled device program; calling the returned
+# object produces an UNMATERIALIZED device value (async dispatch).
+_R8_PROGRAM_GETTERS = {"_fn", "_fn_build", "_fn_probe", "jit"}
+# The dispatch guards: a thunk passed to one of these runs under the
+# fault ladder (classification, breakers, OOM backpressure + retry).
+_R8_GUARD_CALLS = {"_device_call", "_oom_guard", "_watchdogged"}
+# Ladder roots: methods whose whole body IS the guarded region — the
+# collective runner thread executes _enter under _lead's breaker-feeding
+# try, so helpers reached only from it materialize inside the ladder.
+_R8_GUARD_ROOTS = {"_enter"}
+# Calls that force a device result to the host (where a real device
+# fault surfaces under jax's async dispatch).
+_R8_FORCING_FUNCS = {"asarray", "device_get"}
+_R8_FORCING_METHOD = "block_until_ready"
+# Wrappers that force a thunk's return value, making the guard's result
+# safe to touch outside it.
+_R8_LOCAL_FORCERS = _R8_FORCING_FUNCS | {"int", "float", "bool", "tolist",
+                                         "item", "array"}
+
+
+class _R8Analysis:
+    """Per-module taint + guard-domination analysis for R8.
+
+    Taint = "may be an unmaterialized device value": calls of device
+    programs, values returned un-forced through the guard or through a
+    tainted-returning function, and anything derived from those
+    (unpacking, slicing, dtype casts). Forcing taint (np.asarray /
+    device_get / .block_until_ready) must happen inside the guard —
+    outside it, jax's async dispatch surfaces a real device fault as a
+    raw XlaRuntimeError that bypasses classification, the breakers, and
+    the executor's ladder entirely (the PR 9 round-5 bug class)."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.graph: ModuleGraph = ctx.graph()
+        self.parents = ctx.parents()
+        self.node_fn = {fn.node: fn for fn in self.graph.functions.values()}
+        self.program_attrs: Set[str] = set()  # self.X = jax.jit(...)
+        self.tainted_returning: Set[str] = set()
+        self.local_taint: Dict[str, Set[str]] = {}
+        self._pv_cache: Dict[str, Set[str]] = {}
+        self.guard_thunks: Set[ast.AST] = set()
+        self._collect_guard_thunks()
+        self._collect_program_attrs()
+        self._taint_fixpoint()
+        self.dominated = self._guard_dominated()
+
+    # ----------------------------------------------------- guard geometry
+
+    def _collect_guard_thunks(self) -> None:
+        """Lambdas and named local defs passed as arguments to a guard
+        call run under the ladder."""
+        for fn in self.graph.functions.values():
+            for site in fn.calls:
+                if terminal_name(site.node.func) not in _R8_GUARD_CALLS:
+                    continue
+                for arg in site.node.args:
+                    if isinstance(arg, ast.Lambda):
+                        self.guard_thunks.add(arg)
+                    elif isinstance(arg, ast.Name):
+                        qual = fn.nested.get(arg.id)
+                        if qual is not None:
+                            self.guard_thunks.add(
+                                self.graph.functions[qual].node)
+
+    def _enclosing_context(self, node: ast.AST):
+        """Walk parents from `node`: ("thunk", None) when a guard thunk
+        encloses it first, else ("fn", FuncNode) for the innermost named
+        function, else ("module", None)."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if cur in self.guard_thunks:
+                return "thunk", None
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return "fn", self.node_fn.get(cur)
+            cur = self.parents.get(cur)
+        return "module", None
+
+    def _enclosing_named_fn(self, node: ast.AST):
+        """The innermost NAMED function enclosing `node` (lambdas are
+        skipped — their names resolve in the enclosing scope)."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return self.node_fn.get(cur)
+            cur = self.parents.get(cur)
+        return None
+
+    def _guard_dominated(self) -> Set[str]:
+        """Functions whose EVERY in-module call site sits in guarded
+        context (a guard thunk, a guard root, or another dominated
+        function) — their bodies execute under the ladder. Functions
+        with no visible call site (public API) are never dominated.
+
+        Call sites are collected from the FULL tree (lambda bodies
+        included — FuncNode.calls prunes them, but a helper invoked
+        only from inside guard thunks is exactly the dominated case)."""
+        sites: Dict[str, List[ast.AST]] = {}
+        for node in self.ctx.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            fn = self._enclosing_named_fn(node)
+            if fn is None:
+                continue
+            callee = self.graph.resolve(fn, node)
+            if callee is not None:
+                sites.setdefault(callee, []).append(node)
+        dominated: Set[str] = set()
+
+        def guarded_site(node: ast.AST) -> bool:
+            kind, fnode = self._enclosing_context(node)
+            if kind == "thunk":
+                return True
+            return (kind == "fn" and fnode is not None
+                    and (fnode.name in _R8_GUARD_ROOTS
+                         or fnode.qualname in dominated))
+
+        changed = True
+        while changed:
+            changed = False
+            for qual, call_nodes in sites.items():
+                if qual in dominated:
+                    continue
+                if all(guarded_site(n) for n in call_nodes):
+                    dominated.add(qual)
+                    changed = True
+        return dominated
+
+    def in_guard_context(self, node: ast.AST) -> bool:
+        kind, fnode = self._enclosing_context(node)
+        if kind == "thunk":
+            return True
+        return (kind == "fn" and fnode is not None
+                and (fnode.name in _R8_GUARD_ROOTS
+                     or fnode.qualname in self.dominated))
+
+    # -------------------------------------------------------------- taint
+
+    def _collect_program_attrs(self) -> None:
+        for fn in self.graph.functions.values():
+            for node in own_body_walk(fn.node):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and terminal_name(node.value.func)
+                        in _R8_PROGRAM_GETTERS):
+                    for t in node.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and terminal_name(t.value) == "self"):
+                            self.program_attrs.add(t.attr)
+
+    def _taint_env(self, fn) -> Set[str]:
+        """A nested def/lambda closes over its ancestors' locals."""
+        names: Set[str] = set()
+        cur = fn
+        while cur is not None:
+            names |= self.local_taint.get(cur.qualname, set())
+            cur = (self.graph.functions.get(cur.parent)
+                   if cur.parent else None)
+        return names
+
+    def _program_vars(self, fn) -> Set[str]:
+        # Memoized per qualname: program-var bindings derive from
+        # program-getter Assigns only, never from taint, so the set is
+        # invariant across the fixpoint — recomputing it per tainted()
+        # query was the dominant redundant cost on collective.py.
+        cached = self._pv_cache.get(fn.qualname)
+        if cached is not None:
+            return cached
+        out: Set[str] = set()
+        for node in own_body_walk(fn.node):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and terminal_name(node.value.func)
+                    in _R8_PROGRAM_GETTERS):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        parent = (self.graph.functions.get(fn.parent)
+                  if fn.parent else None)
+        if parent is not None:
+            out |= self._program_vars(parent)
+        self._pv_cache[fn.qualname] = out
+        return out
+
+    def tainted(self, expr: ast.AST, fn) -> bool:
+        """May `expr` (evaluated inside function `fn`) be an
+        unmaterialized device value?"""
+        taint = self._taint_env(fn)
+        progs = self._program_vars(fn)
+
+        def walk(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id in taint
+            if isinstance(e, ast.Subscript):
+                return walk(e.value)
+            if isinstance(e, ast.Starred):
+                return walk(e.value)
+            if isinstance(e, ast.Tuple) or isinstance(e, ast.List):
+                return any(walk(x) for x in e.elts)
+            if isinstance(e, ast.BinOp):
+                return walk(e.left) or walk(e.right)
+            if isinstance(e, ast.IfExp):
+                return walk(e.body) or walk(e.orelse)
+            if isinstance(e, ast.Call):
+                f = e.func
+                # program(...) — a dispatch: the canonical taint source
+                if isinstance(f, ast.Name) and f.id in progs:
+                    return True
+                if (isinstance(f, ast.Attribute)
+                        and terminal_name(f.value) == "self"
+                        and f.attr in self.program_attrs):
+                    return True
+                # method chains on a tainted value: .astype/.reshape keep
+                # device-ness; .block_until_ready() forces it
+                if isinstance(f, ast.Attribute) and walk(f.value):
+                    return f.attr != _R8_FORCING_METHOD
+                # guard call whose thunk returns taint un-forced
+                if terminal_name(f) in _R8_GUARD_CALLS:
+                    return self._thunk_returns_taint(e, fn)
+                # call of a tainted-returning function in this module
+                callee = self.graph.resolve(fn, e) if fn is not None else None
+                if callee is not None and callee in self.tainted_returning:
+                    return True
+                return False
+            return False
+
+        return walk(expr)
+
+    def _thunk_returns_taint(self, guard_call: ast.Call, fn) -> bool:
+        for arg in guard_call.args:
+            if isinstance(arg, ast.Lambda):
+                return self._forces(arg.body) is False and self.tainted(
+                    arg.body, fn)
+            if isinstance(arg, ast.Name) and fn is not None:
+                qual = fn.nested.get(arg.id)
+                if qual is None:
+                    continue
+                thunk = self.graph.functions[qual]
+                for node in own_body_walk(thunk.node):
+                    if (isinstance(node, ast.Return) and node.value is not None
+                            and not self._forces(node.value)
+                            and self.tainted(node.value, thunk)):
+                        return True
+                return False
+        return False
+
+    @staticmethod
+    def _forces(expr: ast.AST) -> bool:
+        """Does the outermost operation of `expr` force to host? (int(),
+        np.asarray(), .block_until_ready(), tuples of those...)"""
+        if isinstance(expr, ast.Subscript):
+            return _R8Analysis._forces(expr.value)
+        if isinstance(expr, ast.Tuple):
+            return all(_R8Analysis._forces(e) for e in expr.elts)
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Attribute) and f.attr == _R8_FORCING_METHOD:
+                return True
+            return terminal_name(f) in _R8_LOCAL_FORCERS
+        return False
+
+    def _taint_fixpoint(self) -> None:
+        """Iterate local-assignment taint + tainted-returning functions
+        to a fixpoint (bounded by function count; in practice 2-3
+        rounds). Taint only ever grows, so this terminates."""
+        for _ in range(len(self.graph.functions) + 1):
+            changed = False
+            for fn in self.graph.functions.values():
+                local = self.local_taint.setdefault(fn.qualname, set())
+                for node in own_body_walk(fn.node):
+                    if isinstance(node, ast.Assign):
+                        if not self.tainted(node.value, fn):
+                            continue
+                        for t in node.targets:
+                            for name in _target_names(t):
+                                if name not in local:
+                                    local.add(name)
+                                    changed = True
+                    elif (isinstance(node, ast.Return)
+                          and node.value is not None
+                          and fn.qualname not in self.tainted_returning
+                          and self.tainted(node.value, fn)):
+                        self.tainted_returning.add(fn.qualname)
+                        changed = True
+            if not changed:
+                return
+
+
+def _target_names(t: ast.AST) -> List[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in t.elts:
+            out.extend(_target_names(e))
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_names(t.value)
+    return []
+
+
+def rule_guarded_materialization(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    """R8: in the dispatch modules, forcing a device value to the host
+    (np.asarray / jax.device_get / .block_until_ready) must happen
+    inside the `_device_call`/`_oom_guard` guard or a ladder-dominated
+    helper. jax dispatches asynchronously, so a device fault surfaces at
+    MATERIALIZATION — un-guarded, it escapes as a raw XlaRuntimeError
+    that bypasses classification, the breakers, and the executor's
+    fallback ladder (the PR 9 round-5 review bug, re-fixed here as a
+    machine-checked invariant). Escape: `# pilint: allow-materialize`."""
+    if ctx.path not in R8_FILES:
+        return []
+    out: List[Violation] = []
+    a = _R8Analysis(ctx)
+    for fn in a.graph.functions.values():
+        for node in own_body_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            forced_expr = None
+            label = None
+            if (terminal_name(f) in _R8_FORCING_FUNCS and node.args):
+                forced_expr, label = node.args[0], (dotted_name(f)
+                                                    or terminal_name(f))
+            elif (isinstance(f, ast.Attribute)
+                  and f.attr == _R8_FORCING_METHOD):
+                forced_expr, label = f.value, _R8_FORCING_METHOD
+            if forced_expr is None or not a.tainted(forced_expr, fn):
+                continue
+            if a.in_guard_context(node):
+                continue
+            if ctx.allowed(node.lineno, "materialize"):
+                continue
+            out.append(Violation(
+                ctx.path, node.lineno, "R8", "guarded-materialization",
+                f"`{label}` forces a device dispatch result outside the "
+                "_device_call/ladder guard — with async dispatch a device "
+                "fault surfaces HERE as a raw XlaRuntimeError, bypassing "
+                "classification, the breakers, and the executor's ladder; "
+                "materialize inside the guard thunk or annotate "
+                "`# pilint: allow-materialize(reason)`",
+            ))
+    return out
+
+
+# --------------------------------------------------------------------------
+# R9: probe-claim hygiene (parallel/device_health.py, cluster/health.py)
+
+
+R9_FILES = ("pilosa_tpu/parallel/device_health.py",
+            "pilosa_tpu/cluster/health.py")
+_R9_PROBE_ATTRS = {"probe_at"}
+_R9_STATE_ATTRS = {"probe_at", "opened_at", "state"}
+
+
+def _assigns_probe_claim(fn_node: ast.AST) -> bool:
+    """Does this method write probe-claim state directly? (The claiming
+    primitive — `_gate_locked` sets `b.probe_at` when it hands out the
+    half-open probe.)"""
+    for node in ast.walk(fn_node):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and t.attr in _R9_PROBE_ATTRS:
+                return True
+    return False
+
+
+def _side_effect_free_check(fn_node: ast.AST) -> bool:
+    """A `_due_locked`-style gate check: reads breaker state, writes
+    nothing (no attribute/subscript stores anywhere in the body)."""
+    reads_state = False
+    for node in ast.walk(fn_node):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    return False
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and node.attr in _R9_STATE_ATTRS):
+            reads_state = True
+    return reads_state
+
+
+def rule_probe_claim_hygiene(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    """R9: a method that claims half-open probes for MORE THAN ONE
+    breaker must run a side-effect-free `_due_locked`-style pass over
+    every breaker BEFORE the first claim. Claiming the plane's probe and
+    then short-circuiting on a still-backed-off sig/slice orphans the
+    probe, which expires as a FAILURE and doubles the backoff from
+    short-circuits alone — the bug fixed independently in
+    DevicePlaneHealth.plan and CollectivePlaneHealth.allow, encoded here
+    so the next breaker doesn't re-ship it. Escape: `# pilint:
+    allow-probe(reason)`."""
+    if ctx.path not in R9_FILES:
+        return []
+    out: List[Violation] = []
+    graph = ctx.graph()
+    for cls, methods in graph.methods_of.items():
+        nodes = {name: graph.functions[qual].node
+                 for name, qual in methods.items()}
+        mutators = {name for name, fn in nodes.items()
+                    if _assigns_probe_claim(fn)}
+        checks = {name for name, fn in nodes.items()
+                  if name not in mutators and _side_effect_free_check(fn)}
+        if not mutators:
+            continue
+        mutator_quals = {f"{cls}.{m}" for m in mutators}
+        check_quals = {f"{cls}.{c}" for c in checks}
+        for name, qual in methods.items():
+            if name in mutators:
+                continue
+            fn = graph.functions[qual]
+            claim_lines = sorted(site.lineno for site in fn.calls
+                                 if site.callee in mutator_quals)
+            if len(claim_lines) < 2:
+                continue
+            check_lines = [site.lineno for site in fn.calls
+                           if site.callee in check_quals]
+            if any(line < claim_lines[0] for line in check_lines):
+                continue
+            if ctx.allowed(fn.node.lineno, "probe") or ctx.allowed(
+                    claim_lines[0], "probe"):
+                continue
+            out.append(Violation(
+                ctx.path, claim_lines[0], "R9", "probe-claim-hygiene",
+                f"`{name}` claims half-open probes for {len(claim_lines)} "
+                "breakers with no side-effect-free `_due_locked`-style "
+                "pass before the first claim — a later short-circuit "
+                "orphans the claimed probe, which expires as a failure "
+                "and doubles the backoff from short-circuits alone; "
+                "check every breaker's due-ness first or annotate "
+                "`# pilint: allow-probe(reason)`",
+            ))
+    return out
+
+
+# --------------------------------------------------------------------------
+# R10: None-guarded stats (the PR 12 crash class)
+
+
+_R10_METHODS = {"count", "timing"}
+_R10_BASES = {"stats", "_stats"}
+
+
+def _stats_chain(call: ast.Call) -> Optional[str]:
+    """'self.holder.stats' for `self.holder.stats.count(...)` when the
+    receiver chain ends in a stats attribute, else None."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _R10_METHODS):
+        return None
+    if terminal_name(f.value) not in _R10_BASES:
+        return None
+    return dotted_name(f.value)
+
+
+def _test_asserts_chain(test: ast.AST, chain: str) -> bool:
+    """Does `test` (an if/while/ternary condition) assert `chain` is
+    truthy? Handles `chain`, `chain is not None`, and `and` chains."""
+    if dotted_name(test) == chain:
+        return True
+    if (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.IsNot)
+            and dotted_name(test.left) == chain
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None):
+        return True
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        return any(_test_asserts_chain(v, chain) for v in test.values)
+    return False
+
+
+def _never_none_attr(cls: ast.ClassDef, attr: str) -> bool:
+    """True when every assignment to `self.<attr>` in the class provably
+    yields a non-None value: a constructor call, or the `x or Fallback()`
+    coalescing idiom (Server.stats = stats or InMemoryStatsClient()).
+    One bare-name assignment (could be None) makes the attr nullable."""
+    found = False
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        for t in targets:
+            if not (isinstance(t, ast.Attribute) and t.attr == attr
+                    and terminal_name(t.value) == "self"):
+                continue
+            found = True
+            if isinstance(value, ast.Call):
+                continue
+            if (isinstance(value, ast.BoolOp)
+                    and isinstance(value.op, ast.Or)
+                    and isinstance(value.values[-1], ast.Call)):
+                continue
+            return False
+    return found
+
+
+def rule_none_guarded_stats(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    """R10: a direct `<holder>.stats.count(...)` / `.timing(...)` call
+    must be dominated by a None-check of the SAME stats chain — library
+    embedders run `Holder(None)` with no stats client, and the PR 12
+    review rounds caught ladder counters crashing exactly those degraded
+    paths. Route through a `_count_stat`-style guard helper (whose body
+    is the dominating check) or guard inline. A `self.stats` whose class
+    coalesces it non-None at construction (`stats or InMemoryStats()`)
+    is exempt — that holder is never stats-less. Escape: `# pilint:
+    allow-stat(reason)`."""
+    if not ctx.path.startswith("pilosa_tpu/"):
+        return []
+    out: List[Violation] = []
+    parents = ctx.parents()
+    nonnull_cache: Dict[Tuple[int, str], bool] = {}
+    for node in ctx.nodes():
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _stats_chain(node)
+        if chain is None:
+            continue
+        parts = chain.split(".")
+        if len(parts) == 2 and parts[0] == "self":
+            cls = parents.get(node)
+            while cls is not None and not isinstance(cls, ast.ClassDef):
+                cls = parents.get(cls)
+            if cls is not None:
+                key = (id(cls), parts[1])
+                if key not in nonnull_cache:
+                    nonnull_cache[key] = _never_none_attr(cls, parts[1])
+                if nonnull_cache[key]:
+                    continue
+        # Dominating guard: any enclosing if/ternary/`and` asserting the
+        # chain, with the call on the truthy side.
+        guarded = False
+        child: ast.AST = node
+        cur = parents.get(node)
+        while cur is not None and not guarded:
+            if isinstance(cur, ast.If) and _test_asserts_chain(cur.test, chain):
+                guarded = child not in getattr(cur, "orelse", [])
+                if guarded:
+                    break
+            if isinstance(cur, ast.IfExp) and _test_asserts_chain(cur.test, chain):
+                guarded = child is not cur.orelse
+                if guarded:
+                    break
+            if (isinstance(cur, ast.BoolOp) and isinstance(cur.op, ast.And)
+                    and any(_test_asserts_chain(v, chain)
+                            for v in cur.values[:-1])):
+                guarded = True
+                break
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Early-return guard at this function's top level:
+                # `if chain is None: return` before the call.
+                for stmt in cur.body:
+                    if stmt.lineno >= node.lineno:
+                        break
+                    if (isinstance(stmt, ast.If)
+                            and _is_none_bailout(stmt, chain)):
+                        guarded = True
+                        break
+                break
+            child, cur = cur, parents.get(cur)
+        if guarded:
+            continue
+        if ctx.allowed(node.lineno, "stat"):
+            continue
+        out.append(Violation(
+            ctx.path, node.lineno, "R10", "none-guarded-stats",
+            f"direct `{chain}.{node.func.attr}(...)` with no None-guard — "
+            "stats-less holders (Holder(None), library embedders) crash "
+            "here, and a degraded-path counter must never be what breaks "
+            "the degraded path; route through a `_count_stat`-style "
+            "guard or annotate `# pilint: allow-stat(reason)`",
+        ))
+    return out
+
+
+def _is_none_bailout(stmt: ast.If, chain: str) -> bool:
+    test = stmt.test
+    is_none = (isinstance(test, ast.Compare) and len(test.ops) == 1
+               and isinstance(test.ops[0], ast.Is)
+               and dotted_name(test.left) == chain
+               and isinstance(test.comparators[0], ast.Constant)
+               and test.comparators[0].value is None)
+    is_not_truthy = (isinstance(test, ast.UnaryOp)
+                     and isinstance(test.op, ast.Not)
+                     and dotted_name(test.operand) == chain)
+    if not (is_none or is_not_truthy):
+        return False
+    return bool(stmt.body) and isinstance(
+        stmt.body[-1], (ast.Return, ast.Raise, ast.Continue))
+
+
+# --------------------------------------------------------------------------
+# R11: config-surface completeness
+
+
+# section class -> (Config attr/section name, flag prefix, env prefix,
+# reference doc). A field of one of these dataclasses must be reachable
+# from every operator surface: the TOML parser (_apply_dict, checked as
+# the section-scoped `self.<section>.<field>` store) AND dump (to_toml,
+# checked inside the section's own `[...]` block), a PILOSA_TPU_* env
+# spelling, the CLI flag (mapping key in config.py + --flag in cli.py),
+# and its subsystem doc — the R6/R7 corpus pattern applied to the config
+# plane, so a knob an operator can't discover or round-trip is caught
+# before the operator is.
+R11_SECTIONS: Dict[str, Tuple[str, str, str, str]] = {
+    "SchedulerConfig": ("scheduler", "sched", "SCHED", "docs/scheduler.md"),
+    "StorageConfig": ("storage", "storage", "STORAGE", "docs/durability.md"),
+    "IngestConfig": ("ingest", "ingest", "INGEST", "docs/ingest.md"),
+    "EngineConfig": ("engine", "engine", "ENGINE", "docs/engine-caches.md"),
+    "CollectiveConfig": ("collective", "collective", "COLLECTIVE",
+                         "docs/multichip.md"),
+    "TierConfig": ("tier", "tier", "TIER", "docs/tiered-storage.md"),
+    "ResilienceConfig": ("resilience", "resilience", "RESILIENCE",
+                         "docs/fault-tolerance.md"),
+    "RebalanceConfig": ("rebalance", "rebalance", "REBALANCE",
+                        "docs/rebalance.md"),
+    "ObsConfig": ("obs", "obs", "OBS", "docs/observability.md"),
+}
+CONFIG_FILE = "pilosa_tpu/config.py"
+CLI_FILE = "pilosa_tpu/cli.py"
+
+
+def collect_string_constants(tree: ast.AST) -> Set[str]:
+    """Every string constant in a module, f-string constant parts
+    included (to_toml builds its dump lines as f-strings)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.add(node.value)
+    return out
+
+
+def _is_dataclass(cls: ast.ClassDef) -> bool:
+    for dec in cls.decorator_list:
+        name = terminal_name(dec.func if isinstance(dec, ast.Call) else dec)
+        if name == "dataclass":
+            return True
+    return False
+
+
+def rule_config_surface(ctx: FileContext, env: RepoEnv) -> List[Violation]:
+    """R11: every field of a section `*Config` dataclass is reachable
+    from the whole operator surface. Missing surfaces are listed in one
+    finding per field. Escape: `# pilint: allow-config(reason)` on the
+    field line (for deliberately internal knobs)."""
+    if not env.config_surface_loaded:
+        return []
+    out: List[Violation] = []
+    for node in ctx.nodes():
+        if not (isinstance(node, ast.ClassDef) and node.name in R11_SECTIONS
+                and _is_dataclass(node)):
+            continue
+        section, flag_prefix, env_prefix, doc_path = R11_SECTIONS[node.name]
+        doc_text = env.config_docs.get(doc_path)
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)):
+                continue
+            fname = stmt.target.id
+            if fname.startswith("_"):
+                continue
+            toml_key = fname.replace("_", "-")
+            missing: List[str] = []
+            # Section-scoped: a key another section also spells must not
+            # mask this one's missing parse line / dump row.
+            if f"self.{section}.{fname}" not in env.config_set_attrs:
+                missing.append(
+                    f"TOML parser (_apply_dict: no "
+                    f"`self.{section}.{fname} = ...` store)")
+            dump_prefix = f"{toml_key} = "
+            if not any(c.startswith(dump_prefix)
+                       for c in env.config_dump_rows.get(section, ())):
+                missing.append(
+                    f"TOML dump (no {toml_key!r} row in the [{section}] "
+                    "block of to_toml)")
+            env_name = f"{env_prefix}_{fname.upper()}"
+            if env_name not in env.config_constants:
+                missing.append(f"env spelling (PILOSA_TPU_{env_name})")
+            flag_key = f"{flag_prefix}_{fname}"
+            if flag_key not in env.config_constants:
+                missing.append(f"flag mapping (_apply_flags {flag_key!r})")
+            cli_flag = f"--{flag_prefix}-{toml_key}"
+            if cli_flag not in env.cli_constants:
+                missing.append(f"CLI flag ({cli_flag})")
+            if doc_text is not None and not re.search(
+                    rf"(?<![a-z0-9-]){re.escape(toml_key)}(?![a-z0-9-])",
+                    doc_text):
+                missing.append(f"docs ({doc_path})")
+            if not missing:
+                continue
+            if ctx.allowed(stmt.lineno, "config"):
+                continue
+            out.append(Violation(
+                ctx.path, stmt.lineno, "R11", "config-surface",
+                f"[{node.name}] field `{fname}` is unreachable from: "
+                + "; ".join(missing)
+                + " — an operator can't discover or set what isn't on "
+                "every surface; wire it through or annotate "
+                "`# pilint: allow-config(reason)`",
+            ))
+    return out
+
+
 ALL_RULES = (
     ("R1", rule_swallow),
     ("R2", rule_jax_free),
@@ -759,4 +1559,8 @@ ALL_RULES = (
     ("R5", rule_mutation_epoch),
     ("R6", rule_failpoint_hygiene),
     ("R7", rule_span_hygiene),
+    ("R8", rule_guarded_materialization),
+    ("R9", rule_probe_claim_hygiene),
+    ("R10", rule_none_guarded_stats),
+    ("R11", rule_config_surface),
 )

@@ -1,18 +1,30 @@
-"""File discovery + rule orchestration + report formatting."""
+"""File discovery + rule orchestration + report formatting.
+
+v2 perf model: every file under the repo's lint corpus is read and
+parsed EXACTLY once into a shared cache — the per-file rules, the R6/R7
+cross-file corpora, and the R11 config surface all consume the same
+trees (the v1 runner re-read and re-parsed the tree up to three times).
+`--changed <ref>` lints only files `git diff --name-only <ref>` reports
+(plus untracked ones), with the cross-file corpora still gathered from
+the full tree so repo-level rules stay sound on a partial target set.
+"""
 
 from __future__ import annotations
 
 import ast
 import os
+import subprocess
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from .core import (FileContext, Violation, parse_annotations,
+from .core import (FileContext, Violation, dotted_name, parse_annotations,
                    unused_annotation_violations)
-from .rules import (ALL_RULES, FAILPOINT_DOC, RepoEnv, SPAN_DOC, WIRING_FILES,
-                    build_env, collect_fire_names, collect_span_assert_sites,
+from .rules import (ALL_RULES, CLI_FILE, CONFIG_FILE, FAILPOINT_DOC, R11_SECTIONS,
+                    RepoEnv, SPAN_DOC, WIRING_FILES, build_env,
+                    collect_fire_names, collect_span_assert_sites,
                     collect_span_names, collect_spec_sites,
-                    failpoint_orphan_violations, parse_failpoint_docs,
-                    parse_span_docs, span_orphan_violations)
+                    collect_string_constants, failpoint_orphan_violations,
+                    parse_failpoint_docs, parse_span_docs,
+                    span_orphan_violations)
 
 _SKIP_PARTS = {"__pycache__", ".git"}
 
@@ -40,25 +52,67 @@ def _relpath(path: str, repo_root: Optional[str]) -> str:
     return rel.replace(os.sep, "/")
 
 
+class SourceCache:
+    """rel-path -> (source, tree-or-None): each file is read and parsed
+    once per run, shared by per-file rules and every cross-file corpus."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._entries: Dict[str, Tuple[str, Optional[ast.AST]]] = {}
+
+    def get(self, rel: str) -> Optional[Tuple[str, Optional[ast.AST]]]:
+        if rel in self._entries:
+            return self._entries[rel]
+        full = os.path.join(self.root, rel)
+        try:
+            with open(full, "r", encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            return None
+        try:
+            tree: Optional[ast.AST] = ast.parse(source)
+        except SyntaxError:
+            tree = None
+        self._entries[rel] = (source, tree)
+        return self._entries[rel]
+
+    def tree(self, rel: str) -> Optional[ast.AST]:
+        entry = self.get(rel)
+        return entry[1] if entry else None
+
+    def source(self, rel: str) -> Optional[str]:
+        entry = self.get(rel)
+        return entry[0] if entry else None
+
+
 def lint_file(path: str, env: RepoEnv, repo_root: Optional[str] = None,
-              rules: Optional[Iterable[str]] = None) -> List[Violation]:
+              rules: Optional[Iterable[str]] = None, depth: int = 0,
+              cache: Optional[SourceCache] = None) -> List[Violation]:
     rel = _relpath(path, repo_root)
+    if cache is not None:
+        entry = cache.get(rel)
+        if entry is not None:
+            return lint_source(rel, entry[0], env, rules=rules, depth=depth,
+                               tree=entry[1])
     with open(path, "r", encoding="utf-8") as f:
         source = f.read()
-    return lint_source(rel, source, env, rules=rules)
+    return lint_source(rel, source, env, rules=rules, depth=depth)
 
 
 def lint_source(rel_path: str, source: str, env: RepoEnv,
-                rules: Optional[Iterable[str]] = None) -> List[Violation]:
-    """Lint one in-memory module (the fixture-snippet path for tests)."""
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as e:
-        return [Violation(rel_path, e.lineno or 0, "E0", "syntax-error",
-                          str(e.msg))]
+                rules: Optional[Iterable[str]] = None, depth: int = 0,
+                tree: Optional[ast.AST] = None) -> List[Violation]:
+    """Lint one in-memory module (the fixture-snippet path for tests).
+    `tree` lets the runner hand over the already-parsed AST."""
+    if tree is None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            return [Violation(rel_path, e.lineno or 0, "E0", "syntax-error",
+                              str(e.msg))]
     annotations, violations = parse_annotations(rel_path, source)
     ctx = FileContext(path=rel_path, source=source, tree=tree,
-                      annotations=annotations)
+                      annotations=annotations, depth=depth)
     selected = set(rules) if rules else None
     for rule_id, rule_fn in ALL_RULES:
         if selected and rule_id not in selected:
@@ -71,88 +125,151 @@ def lint_source(rel_path: str, source: str, env: RepoEnv,
     return sorted(violations, key=Violation.sort_key)
 
 
-def _load_failpoint_env(env: RepoEnv, root: str) -> None:
+def _pilosa_files(cache: SourceCache) -> List[str]:
+    return [_relpath(f, cache.root)
+            for f in _discover([os.path.join(cache.root, "pilosa_tpu")])]
+
+
+def _load_failpoint_env(env: RepoEnv, cache: SourceCache) -> None:
     """R6's cross-file corpus, gathered independently of the lint target
     set so `pilint pilosa_tpu/` still validates test specs: the docs
     reference table, every fire() site under pilosa_tpu/, and every
     activation spec under tests/."""
-    import ast as _ast
-
-    doc = os.path.join(root, FAILPOINT_DOC)
+    doc = os.path.join(cache.root, FAILPOINT_DOC)
     if os.path.exists(doc):
         with open(doc, "r", encoding="utf-8") as f:
             env.failpoint_doc_names = parse_failpoint_docs(f.read())
         env.failpoint_docs_loaded = True
-    for f in _discover([os.path.join(root, "pilosa_tpu")]):
-        try:
-            with open(f, "r", encoding="utf-8") as fh:
-                env.failpoint_fire_sites |= collect_fire_names(
-                    _ast.parse(fh.read()))
-        except (OSError, SyntaxError):
-            continue  # unreadable/unparseable files get their own E0
-    tests_dir = os.path.join(root, "tests")
+    for rel in _pilosa_files(cache):
+        tree = cache.tree(rel)
+        if tree is not None:
+            env.failpoint_fire_sites |= collect_fire_names(tree)
+    tests_dir = os.path.join(cache.root, "tests")
     if os.path.isdir(tests_dir):
         for f in _discover([tests_dir]):
-            try:
-                with open(f, "r", encoding="utf-8") as fh:
-                    src = fh.read()
-            except OSError:
-                continue
-            env.failpoint_spec_sites.extend(
-                collect_spec_sites(_relpath(f, root), src))
+            rel = _relpath(f, cache.root)
+            src = cache.source(rel)
+            if src is not None:
+                env.failpoint_spec_sites.extend(collect_spec_sites(rel, src))
 
 
-def _load_span_env(env: RepoEnv, root: str) -> None:
+def _load_span_env(env: RepoEnv, cache: SourceCache) -> None:
     """R7's cross-file corpus, mirroring R6's: the span reference table
     in docs/observability.md, every constant recorder span name under
     pilosa_tpu/, and every span name tests assert on under tests/."""
-    import ast as _ast
-
-    doc = os.path.join(root, SPAN_DOC)
+    doc = os.path.join(cache.root, SPAN_DOC)
     if os.path.exists(doc):
         with open(doc, "r", encoding="utf-8") as f:
             env.span_doc_names = parse_span_docs(f.read())
         env.span_docs_loaded = True
-    for f in _discover([os.path.join(root, "pilosa_tpu")]):
-        try:
-            with open(f, "r", encoding="utf-8") as fh:
-                env.span_record_sites |= collect_span_names(
-                    _ast.parse(fh.read()))
-        except (OSError, SyntaxError):
-            continue  # unreadable/unparseable files get their own E0
-    tests_dir = os.path.join(root, "tests")
+    for rel in _pilosa_files(cache):
+        tree = cache.tree(rel)
+        if tree is not None:
+            env.span_record_sites |= collect_span_names(tree)
+    tests_dir = os.path.join(cache.root, "tests")
     if os.path.isdir(tests_dir):
         for f in _discover([tests_dir]):
-            try:
-                with open(f, "r", encoding="utf-8") as fh:
-                    src = fh.read()
-            except OSError:
-                continue
-            env.span_assert_sites.extend(
-                collect_span_assert_sites(_relpath(f, root), src))
+            rel = _relpath(f, cache.root)
+            src = cache.source(rel)
+            if src is not None:
+                env.span_assert_sites.extend(
+                    collect_span_assert_sites(rel, src))
+
+
+def _load_config_env(env: RepoEnv, cache: SourceCache) -> None:
+    """R11's corpus: string constants of config.py (env spellings,
+    flag-mapping keys) and cli.py (flag spellings), the section-scoped
+    parse surface (every dotted `self.<section>.<field>` store) and
+    to_toml dump rows (row constants bucketed by their `[section]`
+    header, in source order — a key two sections share must not mask
+    either one's drift), plus each section's reference doc text."""
+    import re as _re
+
+    cfg_tree = cache.tree(CONFIG_FILE)
+    cli_tree = cache.tree(CLI_FILE)
+    if cfg_tree is None or cli_tree is None:
+        return  # not this repo's layout (fixture run): rule stays off
+    env.config_constants = collect_string_constants(cfg_tree)
+    env.cli_constants = collect_string_constants(cli_tree)
+    for node in ast.walk(cfg_tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            dn = dotted_name(t)
+            if dn is not None:
+                env.config_set_attrs.add(dn)
+    for node in ast.walk(cfg_tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "to_toml"):
+            consts = sorted(
+                (c.lineno, c.col_offset, c.value) for c in ast.walk(node)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str))
+            current = "_top"
+            for _ln, _col, value in consts:
+                m = _re.fullmatch(r"\[([a-z][a-z-]*)\]", value)
+                if m:
+                    current = m.group(1).replace("-", "_")
+                    continue
+                env.config_dump_rows.setdefault(current, set()).add(value)
+    for _cls, (_section, _flag, _env, doc_path) in R11_SECTIONS.items():
+        full = os.path.join(cache.root, doc_path)
+        if doc_path not in env.config_docs and os.path.exists(full):
+            with open(full, "r", encoding="utf-8") as f:
+                env.config_docs[doc_path] = f.read()
+    env.config_surface_loaded = True
+
+
+def changed_files(ref: str, root: str) -> List[str]:
+    """Lint targets for --changed: `git diff --name-only <ref>` plus
+    untracked files, filtered to .py paths that still exist AND sit in
+    the lint corpus (pilosa_tpu/) — the full-tree run lints exactly
+    that corpus, and test files deliberately violate rules on purpose
+    (fixture snippets), so a changed test must not fail the gate."""
+    out: List[str] = []
+    for args in (["git", "diff", "--name-only", ref],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(args, cwd=root, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(args)} failed: {proc.stderr.strip()}")
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if (line.endswith(".py") and line.startswith("pilosa_tpu/")
+                    and os.path.exists(os.path.join(root, line))):
+                out.append(os.path.join(root, line))
+    return sorted(set(out))
 
 
 def lint_paths(paths: Iterable[str], repo_root: Optional[str] = None,
-               rules: Optional[Iterable[str]] = None) -> List[Violation]:
+               rules: Optional[Iterable[str]] = None,
+               depth: int = 0) -> List[Violation]:
     """Lint every .py file under `paths`. repo_root anchors the relative
-    paths rules match on (zone membership, wiring files); default cwd."""
+    paths rules match on (zone membership, wiring files); default cwd.
+    `depth` bounds the interprocedural walks (0 = DEFAULT_DEPTH)."""
     files = _discover(paths)
     root = repo_root or os.getcwd()
+    cache = SourceCache(root)
     sources: Dict[str, str] = {}
     for rel in WIRING_FILES:
-        full = os.path.join(root, rel)
-        if os.path.exists(full):
-            with open(full, "r", encoding="utf-8") as f:
-                sources[rel] = f.read()
+        src = cache.source(rel)
+        if src is not None:
+            sources[rel] = src
     env = build_env(sources)
     selected = set(rules) if rules else None
     if selected is None or "R6" in selected:
-        _load_failpoint_env(env, root)
+        _load_failpoint_env(env, cache)
     if selected is None or "R7" in selected:
-        _load_span_env(env, root)
+        _load_span_env(env, cache)
+    if selected is None or "R11" in selected:
+        _load_config_env(env, cache)
     out: List[Violation] = []
     for f in files:
-        out.extend(lint_file(f, env, repo_root=root, rules=rules))
+        out.extend(lint_file(f, env, repo_root=root, rules=rules,
+                             depth=depth, cache=cache))
     if selected is None or "R6" in selected:
         out.extend(failpoint_orphan_violations(env))
     if selected is None or "R7" in selected:
