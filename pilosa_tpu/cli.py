@@ -42,6 +42,35 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--cluster-replicas", dest="cluster_replicas", type=int)
     p.add_argument("--long-query-time", dest="long_query_time", type=float)
     p.add_argument("--anti-entropy-interval", dest="anti_entropy_interval", type=float)
+    p.add_argument("--anti-entropy-jitter", dest="anti_entropy_jitter",
+                   type=float,
+                   help="sweep-interval jitter fraction (de-stampedes a "
+                        "restarted cluster's anti-entropy timers)")
+    p.add_argument("--anti-entropy-pace", dest="anti_entropy_pace",
+                   type=float,
+                   help="seconds slept between per-fragment syncs inside "
+                        "one anti-entropy sweep")
+    p.add_argument("--replication-write-consistency",
+                   dest="replication_write_consistency",
+                   choices=["one", "quorum", "all"],
+                   help="owners that must apply before a write acks; an "
+                        "unmet level is a retryable 503 after hints were "
+                        "enqueued for the missed owners")
+    p.add_argument("--replication-hint-ttl", dest="replication_hint_ttl",
+                   type=float,
+                   help="seconds before an undelivered hint expires to "
+                        "priority anti-entropy")
+    p.add_argument("--replication-hint-max-bytes",
+                   dest="replication_hint_max_bytes", type=int,
+                   help="per-peer hint log byte budget (0 = unbounded)")
+    p.add_argument("--replication-deliver-interval",
+                   dest="replication_deliver_interval", type=float,
+                   help="hint delivery daemon sweep cadence in seconds "
+                        "(0 disables background delivery)")
+    p.add_argument("--replication-deliver-batch-bytes",
+                   dest="replication_deliver_batch_bytes", type=int,
+                   help="max hint-log bytes replayed toward one peer per "
+                        "delivery sweep")
     p.add_argument("--gossip-probe-interval", dest="gossip_probe_interval", type=float)
     p.add_argument("--gossip-failover-probes", dest="gossip_failover_probes", type=int)
     p.add_argument("--gossip-probe-timeout", dest="gossip_probe_timeout", type=float)

@@ -23,6 +23,15 @@ class HolderSyncer:
         self.holder = server.holder
         self.cluster = server.cluster
         self.client = server.client
+        # Hinted-handoff store (cluster/hints.py): shards with pending or
+        # expired hints sync FIRST — they are the ones KNOWN to be
+        # divergent — instead of waiting their turn in the full-holder
+        # walk. None for library holders without a server-owned store.
+        self.hints = getattr(server, "hints", None)
+        # Per-sweep pacing ([anti-entropy] pace): seconds slept between
+        # fragment syncs so one sweep can't saturate replicas with
+        # back-to-back block RPCs.
+        self.pace = getattr(server, "anti_entropy_pace", 0.0)
 
     def _remote_replicas(self, index: str, shard: int):
         nodes = self.cluster.shard_nodes(index, shard)
@@ -37,6 +46,13 @@ class HolderSyncer:
         return [n for n in nodes if n.id != me and not health.is_down(n.id)]
 
     def sync_holder(self) -> None:
+        import time as _t
+
+        # Collect the whole fragment worklist first so hint-flagged
+        # shards (pending, expired, or overflowed hints — the shards
+        # KNOWN to be divergent) can be ordered to the FRONT of the
+        # sweep; everything else keeps its stable walk order behind them.
+        work = []
         for index_name in self.holder.index_names():
             idx = self.holder.index(index_name)
             self._sync_attrs(index_name, None, idx.column_attr_store)
@@ -46,22 +62,56 @@ class HolderSyncer:
                 for view_name in fld.view_names():
                     view = fld.view(view_name)
                     for shard in view.available_shards():
-                        replicas = self._remote_replicas(index_name, shard)
-                        if not replicas:
-                            continue
-                        try:
-                            self._sync_fragment(
-                                index_name, field_name, view_name, shard, replicas
-                            )
-                        except (PilosaError, OSError) as e:
-                            # One fragment's failure (peer down mid-sync, an
-                            # oversized diff rejected, a local disk fault
-                            # while persisting a merge) must not abort the
-                            # rest of the sweep.
-                            self.server.logger.error(
-                                "anti-entropy: %s/%s/%s/%s sync failed: %s",
-                                index_name, field_name, view_name, shard, e,
-                            )
+                        work.append((index_name, field_name, view_name,
+                                     shard))
+        priority = (self.hints.priority_shards()
+                    if self.hints is not None else set())
+        if priority:
+            work.sort(key=lambda w: (w[0], w[3]) not in priority)
+        first = True
+        unrepaired = set()
+        for index_name, field_name, view_name, shard in work:
+            if not first and self.pace > 0:
+                # Per-sweep pacing: spread the block-RPC load out.
+                _t.sleep(self.pace)
+            first = False
+            replicas = self._remote_replicas(index_name, shard)
+            if not replicas:
+                if replicas is not None:
+                    # Owned here but every remote replica is DOWN:
+                    # nothing was repaired. A hint-flagged shard must
+                    # keep its flag, or the outage that created the
+                    # divergence would also erase its priority ordering.
+                    # (None = not owned here: the owners' sweeps are the
+                    # repair path, so those flags still settle below.)
+                    unrepaired.add((index_name, shard))
+                continue
+            try:
+                self._sync_fragment(
+                    index_name, field_name, view_name, shard, replicas
+                )
+            except (PilosaError, OSError) as e:
+                # One fragment's failure (peer down mid-sync, an
+                # oversized diff rejected, a local disk fault
+                # while persisting a merge) must not abort the
+                # rest of the sweep.
+                self.server.logger.error(
+                    "anti-entropy: %s/%s/%s/%s sync failed: %s",
+                    index_name, field_name, view_name, shard, e,
+                )
+                unrepaired.add((index_name, shard))
+        if self.hints is not None:
+            # A completed sweep settles every hint-priority flag whose
+            # shard was actually repaired (pending per-peer hint records
+            # stay — replay is idempotent and cheaper than dropping them
+            # mid-log); flags for shards that failed mid-sync or had no
+            # reachable replica survive to keep their ordering. Flags for
+            # shards this node doesn't even hold are settled: their
+            # owners' sweeps are the repair path, and keeping dead flags
+            # would pin the priority set forever.
+            for key in priority:
+                if key not in unrepaired:
+                    self.hints.note_synced(*key)
 
     # ---------------------------------------------------------------- attrs
 

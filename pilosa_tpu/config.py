@@ -32,6 +32,15 @@ class ClusterConfig:
 @dataclass
 class AntiEntropyConfig:
     interval: float = 600.0  # seconds (reference default 10m)
+    # De-stampeding fraction: the first sweep starts anywhere in
+    # [0, interval*(1+jitter)] and the steady-state period varies by
+    # ±jitter, so a restarted cluster's sweeps drift apart instead of
+    # landing on every node at the same instant forever. 0 restores the
+    # fixed timer.
+    jitter: float = 0.1
+    # Seconds slept between per-fragment syncs inside one sweep, so a
+    # sweep cannot saturate replicas with back-to-back block RPCs.
+    pace: float = 0.0
 
 
 @dataclass
@@ -94,6 +103,11 @@ from .cluster.health import ResilienceConfig  # noqa: E402
 # rebalance machinery (cluster/rebalance.py). See docs/rebalance.md.
 from .cluster.rebalance import RebalanceConfig  # noqa: E402
 
+# And for [replication]: the durable write-replication knobs (hinted
+# handoff, write-consistency ack gating) live with the hint store
+# (cluster/hints.py, jax-free). See docs/durability.md.
+from .cluster.hints import ReplicationConfig  # noqa: E402
+
 # And for [obs]: the per-query tracing knobs live with the trace recorder
 # (pilosa_tpu/obs/, jax-free). See docs/observability.md.
 from .obs import ObsConfig  # noqa: E402
@@ -143,6 +157,7 @@ class Config:
     tier: TierConfig = field(default_factory=TierConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     rebalance: RebalanceConfig = field(default_factory=RebalanceConfig)
+    replication: ReplicationConfig = field(default_factory=ReplicationConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     translation: TranslationConfig = field(default_factory=TranslationConfig)
@@ -177,6 +192,8 @@ class Config:
         self.cluster.long_query_time = c.get("long-query-time", self.cluster.long_query_time)
         a = d.get("anti-entropy", {})
         self.anti_entropy.interval = a.get("interval", self.anti_entropy.interval)
+        self.anti_entropy.jitter = a.get("jitter", self.anti_entropy.jitter)
+        self.anti_entropy.pace = a.get("pace", self.anti_entropy.pace)
         g = d.get("gossip", {})
         self.gossip.probe_interval = g.get("probe-interval", self.gossip.probe_interval)
         self.gossip.probe_timeout = g.get("probe-timeout", self.gossip.probe_timeout)
@@ -221,6 +238,17 @@ class Config:
         self.resilience.collective_breaker_backoff_max = r.get(
             "collective-breaker-backoff-max",
             self.resilience.collective_breaker_backoff_max)
+        rp = d.get("replication", {})
+        self.replication.write_consistency = rp.get(
+            "write-consistency", self.replication.write_consistency)
+        self.replication.hint_ttl = rp.get(
+            "hint-ttl", self.replication.hint_ttl)
+        self.replication.hint_max_bytes = rp.get(
+            "hint-max-bytes", self.replication.hint_max_bytes)
+        self.replication.deliver_interval = rp.get(
+            "deliver-interval", self.replication.deliver_interval)
+        self.replication.deliver_batch_bytes = rp.get(
+            "deliver-batch-bytes", self.replication.deliver_batch_bytes)
         rb = d.get("rebalance", {})
         self.rebalance.online = rb.get("online", self.rebalance.online)
         self.rebalance.max_concurrent_streams = rb.get(
@@ -350,9 +378,24 @@ class Config:
             v = env(name, cast)
             if v is not None:
                 setattr(self.cluster, attr, v)
-        v = env("ANTI_ENTROPY_INTERVAL", float)
-        if v is not None:
-            self.anti_entropy.interval = v
+        for attr, name, cast in [
+            ("interval", "ANTI_ENTROPY_INTERVAL", float),
+            ("jitter", "ANTI_ENTROPY_JITTER", float),
+            ("pace", "ANTI_ENTROPY_PACE", float),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.anti_entropy, attr, v)
+        for attr, name, cast in [
+            ("write_consistency", "REPLICATION_WRITE_CONSISTENCY", str),
+            ("hint_ttl", "REPLICATION_HINT_TTL", float),
+            ("hint_max_bytes", "REPLICATION_HINT_MAX_BYTES", int),
+            ("deliver_interval", "REPLICATION_DELIVER_INTERVAL", float),
+            ("deliver_batch_bytes", "REPLICATION_DELIVER_BATCH_BYTES", int),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.replication, attr, v)
         for attr, name, cast in [
             ("probe_interval", "GOSSIP_PROBE_INTERVAL", float),
             ("probe_timeout", "GOSSIP_PROBE_TIMEOUT", float),
@@ -511,6 +554,16 @@ class Config:
             "cluster_disabled": ("cluster", "disabled"),
             "long_query_time": ("cluster", "long_query_time"),
             "anti_entropy_interval": ("anti_entropy", "interval"),
+            "anti_entropy_jitter": ("anti_entropy", "jitter"),
+            "anti_entropy_pace": ("anti_entropy", "pace"),
+            "replication_write_consistency":
+                ("replication", "write_consistency"),
+            "replication_hint_ttl": ("replication", "hint_ttl"),
+            "replication_hint_max_bytes": ("replication", "hint_max_bytes"),
+            "replication_deliver_interval":
+                ("replication", "deliver_interval"),
+            "replication_deliver_batch_bytes":
+                ("replication", "deliver_batch_bytes"),
             "gossip_probe_interval": ("gossip", "probe_interval"),
             "gossip_probe_timeout": ("gossip", "probe_timeout"),
             "gossip_probe_failures": ("gossip", "probe_failures"),
@@ -636,6 +689,15 @@ class Config:
             "",
             "[anti-entropy]",
             f"interval = {self.anti_entropy.interval}",
+            f"jitter = {self.anti_entropy.jitter}",
+            f"pace = {self.anti_entropy.pace}",
+            "",
+            "[replication]",
+            f"write-consistency = {fmt(self.replication.write_consistency)}",
+            f"hint-ttl = {self.replication.hint_ttl}",
+            f"hint-max-bytes = {self.replication.hint_max_bytes}",
+            f"deliver-interval = {self.replication.deliver_interval}",
+            f"deliver-batch-bytes = {self.replication.deliver_batch_bytes}",
             "",
             "[gossip]",
             f"probe-interval = {self.gossip.probe_interval}",
@@ -767,6 +829,9 @@ class Config:
             is_coordinator=self.cluster.coordinator,
             replica_n=self.cluster.replicas,
             anti_entropy_interval=self.anti_entropy.interval,
+            anti_entropy_jitter=self.anti_entropy.jitter,
+            anti_entropy_pace=self.anti_entropy.pace,
+            replication_config=self.replication.validate(),
             long_query_time=self.cluster.long_query_time,
             metric_poll_interval=self.metric.poll_interval,
             primary_translate_store_url=self.translation.primary_url or None,

@@ -78,6 +78,40 @@ DELTA_JOURNAL_OPS = int(
 # itertools.count.__next__ is atomic under CPython's GIL.
 _INCARNATION = itertools.count(1)
 
+# Hinted-handoff op capture (cluster/hints.py): while a capture is armed
+# on the CURRENT THREAD, every WAL op record a fragment encodes is also
+# handed to the collector as (fragment, record_bytes) — the coordinator's
+# local apply thereby yields the exact byte payload a missed replica
+# forward must eventually replay, with zero re-encoding and no chance of
+# the hint format drifting from the WAL format. Thread-local so a write
+# fan-out capturing its own apply never sees concurrent writers' ops, and
+# inert (one attribute miss) when no capture is armed.
+_hint_capture = threading.local()
+
+
+class capture_hint_ops:
+    """Context manager arming hint capture on this thread; appended
+    entries land in `into` as (fragment, op_record_bytes)."""
+
+    def __init__(self, into: list):
+        self.into = into
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_hint_capture, "into", None)
+        _hint_capture.into = self.into
+        return self.into
+
+    def __exit__(self, *exc):
+        _hint_capture.into = self._prev
+        return False
+
+
+def _capture_op(frag, record: bytes) -> None:
+    into = getattr(_hint_capture, "into", None)
+    if into is not None:
+        into.append((frag, record))
+
 
 def _block_hasher():
     """THE merkle block digest (one definition for the streaming blocks()
@@ -596,10 +630,14 @@ class Fragment:
         return True
 
     def _append_op(self, typ: int, pos: int) -> None:
+        rec = None
+        if self._wal or getattr(_hint_capture, "into", None) is not None:
+            rec = encode_op(typ, pos)
+            _capture_op(self, rec)
         if self._wal:
             failpoints.fire("wal-append")
             try:
-                self._wal.write(encode_op(typ, pos))
+                self._wal.write(rec)
                 self._wal.flush()
             except OSError:
                 self._truncate_torn_append()
@@ -640,9 +678,12 @@ class Fragment:
         bulk mutation. The in-memory mutation is already applied; crash
         safety comes from record replay at reopen (torn tails truncate,
         exactly like point ops)."""
+        rec = None
+        if self._wal or getattr(_hint_capture, "into", None) is not None:
+            rec = encode_bulk_op(adds, removes)
+            _capture_op(self, rec)
         if self._wal:
             failpoints.fire("bulk-wal-append")
-            rec = encode_bulk_op(adds, removes)
             try:
                 self._wal.write(rec)
                 self._wal.flush()
@@ -1102,6 +1143,19 @@ class Fragment:
         # the journal bound).
         self._invalidate_bulk(allpos // np.uint64(SHARD_WIDTH), allpos)
         self._maybe_snapshot()
+
+    def apply_hint_positions(self, add_pos, rem_pos) -> None:
+        """Replay one delivered hint record (cluster/hints.py): positions-
+        based idempotent set/clear through the same WAL-backed path the
+        anti-entropy block merge uses, so a redelivered record is
+        harmless and the replay is as durable as a direct write."""
+        add_pos = np.asarray(add_pos, dtype=np.uint64)
+        rem_pos = np.asarray(rem_pos, dtype=np.uint64)
+        if not len(add_pos) and not len(rem_pos):
+            return
+        with self._mu:
+            self._check_moved()
+            self._apply_merge_diff(add_pos, rem_pos)
 
     # --------------------------------------------------------------- import
 

@@ -190,6 +190,22 @@ class DiagnosticsCollector:
                 1 for p in snap.get("peers", {}).values()
                 if p.get("state") != "closed"
             )
+        # Durable write replication shape (docs/durability.md): the
+        # configured ack level and the hinted-handoff flow — writes a
+        # replica missed that are queued, delivered, or expired to the
+        # anti-entropy backstop (per-peer backlog detail stays in
+        # /debug/vars).
+        hints = getattr(self.server, "hints", None)
+        if hints is not None:
+            snap = hints.snapshot()
+            info["replicationWriteConsistency"] = snap.get(
+                "writeConsistency", "one")
+            info["replicationHintsAppended"] = snap.get("hints_appended", 0)
+            info["replicationHintsDelivered"] = snap.get(
+                "hints_delivered", 0)
+            info["replicationHintsExpired"] = snap.get("hints_expired", 0)
+            info["replicationHintsPendingPeers"] = len(snap.get("peers", {}))
+            info["replicationHintDrains"] = snap.get("drains", 0)
         # Collective-plane shape (docs/multichip.md): how much full-index
         # serving rode the fused SPMD path vs fell back to the HTTP
         # fan-out, how often barriers timed out, and how well the batched

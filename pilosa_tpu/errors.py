@@ -107,6 +107,25 @@ class StaleRoutingEpochError(PilosaError):
     message = "stale routing epoch"
 
 
+class WriteConsistencyError(PilosaError):
+    """A write fan-out applied on fewer owners than the configured
+    `[replication] write-consistency` level requires (applied == 0 is the
+    degenerate total-owner-loss case). Maps to HTTP 503 — RETRYABLE: the
+    cluster is degraded, not the request malformed, so clients and load
+    balancers should back off and retry rather than fail the write. There
+    is no rollback: the owners that applied keep the write, hints were
+    enqueued for the missed owners before this raised, and a client retry
+    re-applies idempotent set/clear ops."""
+
+    message = "write consistency not met"
+
+    def __init__(self, *args, level=None, required=None, applied=None):
+        super().__init__(*args)
+        self.level = level
+        self.required = required
+        self.applied = applied
+
+
 class CorruptFragmentError(PilosaError, ValueError):
     """On-disk fragment/bitmap data failed validation (bad cookie, bogus
     container payload, checksum-failing op record). Carries where the file

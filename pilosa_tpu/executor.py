@@ -65,7 +65,12 @@ def _is_node_failure(e) -> bool:
     REQUEST's budget running out on a healthy peer — one client's tight
     deadline must not mark nodes unavailable and poison routing."""
     status = getattr(e, "status", 0)
-    if status == 503 and "deadline exceeded" in str(e):
+    if status == 503 and ("deadline exceeded" in str(e)
+                          or "write consistency" in str(e)):
+        # Deadline expiry is the REQUEST's budget dying on a healthy peer;
+        # a write-consistency 503 is the PEER's own replica set being
+        # degraded — both are deterministic answers from a live node, not
+        # evidence the node itself failed.
         return False
     return status == 0 or status >= 500
 
@@ -169,6 +174,15 @@ class Executor:
         # clean retryable error. The server installs
         # [rebalance] cutover-pause-max here.
         self.cutover_wait = 2.0
+        # Hinted handoff (cluster/hints.py), wired by the server: when a
+        # replica forward is skipped (breaker open) or fails at the
+        # transport, the write's captured op batch lands in the peer's
+        # durable hint log instead of waiting for the next anti-entropy
+        # sweep. None (library use) keeps the skip-and-sweep behavior.
+        self.hints = None
+        # [replication] section (write-consistency ack gating); None =
+        # the reference's ack-on-first-apply behavior.
+        self.replication_config = None
         from .logger import NopLogger
 
         self.logger = NopLogger()  # server wires its logger in open()
@@ -1458,7 +1472,7 @@ class Executor:
     # --------------------------------------------------------------- writes
 
     def _forward_tolerant(self, node, send, errors, note_app_error,
-                          what: str = ""):
+                          what: str = "", hint=None):
         """THE per-target write-tolerance step (one implementation for
         the single-shard and the group fan-outs): breaker short-circuit
         (don't pay a connect timeout per write; an elapsed backoff makes
@@ -1469,12 +1483,41 @@ class Executor:
         `note_app_error` so the caller surfaces the divergence only
         after every other owner got its forward — and health recording.
         Returns the forward's result on success, None otherwise (errors
-        are appended, never raised)."""
+        are appended, never raised).
+
+        `hint` (hinted handoff, cluster/hints.py) is a callable(node) ->
+        bool that appends this write's captured op batch to the peer's
+        durable hint log; it runs when the forward is skipped at the
+        breaker or fails at the transport, so a dead replica costs an
+        O(batch) disk append — never a connect timeout — and the missed
+        write replays when the peer returns. While a peer has UNDELIVERED
+        hints, later writes append behind them even though the breaker
+        would admit a send: per-peer FIFO keeps replay order identical to
+        coordinator apply order, so a drain can never resurrect a bit
+        that a post-recovery write already cleared. A hinted forward
+        still counts as NOT applied for write-consistency accounting."""
         from .server.client import ClientError
 
+        if hint is not None and self.hints is not None \
+                and self.hints.pending(node.id):
+            if hint(node):
+                self._count_stat("WriteForwardHinted")
+                errors.append(
+                    f"{node.id}{what}: hinted (queued behind pending "
+                    "handoff)")
+                return None
+            # Hint append refused (byte budget / disk fault): fall through
+            # to the direct forward — applying out of order beats dropping
+            # the write, and anti-entropy owns the reconciliation either
+            # way (the refused append flagged the shard for priority sync).
         if not self.health.allow_request(node.id):
             self._count_stat("WriteForwardSkipped")
-            errors.append(f"{node.id}{what}: unavailable (breaker open)")
+            if hint is not None and hint(node):
+                self._count_stat("WriteForwardHinted")
+                errors.append(
+                    f"{node.id}{what}: unavailable (breaker open; hinted)")
+            else:
+                errors.append(f"{node.id}{what}: unavailable (breaker open)")
             return None
         try:
             res = send(node)
@@ -1486,20 +1529,39 @@ class Executor:
                 return None
             self.health.record_failure(node.id)
             self._count_stat("WriteForwardFailed")
+            if hint is not None and hint(node):
+                self._count_stat("WriteForwardHinted")
             errors.append(f"{node.id}: {e}")
             return None
         self.health.record_success(node.id)
         return res if res is not None else True
 
+    def _write_required(self, n_owners: int) -> int:
+        """Owners that must APPLY before a write acks ([replication]
+        write-consistency): 1 without config (the reference behavior)."""
+        cfg = self.replication_config
+        return 1 if cfg is None else cfg.required_owners(n_owners)
+
+    def _write_level(self) -> str:
+        cfg = self.replication_config
+        return "one" if cfg is None else cfg.write_consistency
+
     def tolerant_owner_fanout(self, index: str, shard: int, remote: bool,
-                              local_fn, forward_fn, on_forward_ok=None):
+                              local_fn, forward_fn, on_forward_ok=None,
+                              hint=None):
         """THE write-tolerance policy, shared by PQL writes and bulk
-        imports (executor.go:1109): apply locally, forward to every other
-        owner, mark dead owners unavailable and skip them (anti-entropy
-        repairs a lagging replica when it returns), finish the whole loop
-        before surfacing a deterministic 4xx rejection (so one lagging
-        replica cannot cause extra divergence on the others), and raise
-        only if NO owner applied.
+        imports (executor.go:1109): apply locally FIRST (arming the
+        caller's hint capture with this write's op bytes), forward to
+        every other owner, hint-or-skip dead owners (hinted handoff
+        replays the miss when the peer returns; anti-entropy remains the
+        backstop), finish the whole loop before surfacing a deterministic
+        4xx rejection (so one lagging replica cannot cause extra
+        divergence on the others), then gate the ack on the configured
+        write-consistency level: a write that applied on fewer owners
+        than `one|quorum|all` requires surfaces as a typed retryable 503
+        (errors.WriteConsistencyError) AFTER hints were enqueued for the
+        missed owners — the applied copies stand, there is no rollback
+        (docs/durability.md "Write-path consistency").
 
         Live-rebalance cutovers surface here as ShardMovedError (the
         local fragment froze) or a 409 from a frozen remote owner: the
@@ -1508,15 +1570,15 @@ class Executor:
         retrying up to `cutover_wait` while the commit broadcast lands,
         so a write racing the cutover follows the shard to its new owner
         instead of failing. Past the cap it surfaces clean (retryable)."""
-        from .errors import ShardMovedError
+        from .errors import ShardMovedError, WriteConsistencyError
 
         deadline = _time.monotonic() + (0.0 if remote else
                                         max(self.cutover_wait, 0.0))
         while True:
             try:
-                self._owner_fanout_once(
-                    index, shard, remote, local_fn, forward_fn, on_forward_ok)
-                return
+                applied, total, errors = self._owner_fanout_once(
+                    index, shard, remote, local_fn, forward_fn,
+                    on_forward_ok, hint)
             except PilosaError as e:
                 mid_cutover = isinstance(e, ShardMovedError) or (
                     getattr(e, "status", 0) == 409)
@@ -1525,9 +1587,27 @@ class Executor:
                 if self.holder.stats is not None:
                     self.holder.stats.count("CutoverWriteWait", 1)
                 _time.sleep(0.02)
+                continue
+            if remote:
+                # Forwarded leg: the COORDINATOR owns level accounting
+                # (our `applied` counts the forwarder's owners as
+                # fictitious applies).
+                return
+            required = self._write_required(total)
+            if applied < required:
+                self._count_stat("WriteConsistencyUnmet")
+                raise WriteConsistencyError(
+                    f"applied on {applied}/{total} owners of {index}/"
+                    f"shard {shard}, level {self._write_level()!r} "
+                    f"requires {required}: " + "; ".join(errors),
+                    level=self._write_level(), required=required,
+                    applied=applied,
+                )
+            return
 
     def _owner_fanout_once(self, index, shard, remote, local_fn, forward_fn,
-                           on_forward_ok):
+                           on_forward_ok, hint=None):
+        """One fan-out pass; returns (applied, n_owners, errors)."""
         applied = 0
         errors = []
         app_error = [None]
@@ -1547,7 +1627,11 @@ class Executor:
 
             raise ShardMovedError(
                 f"{index}/shard {shard} is not served by this node")
-        for node in owners:
+        # Local apply first (stable otherwise): the caller's hint capture
+        # is filled by the local apply, and a forward can miss — and need
+        # those bytes — at ANY position in the owner walk. Replicas have
+        # no ordering contract among themselves, so the reorder is free.
+        for node in sorted(owners, key=lambda n: n.id != self.node.id):
             if node.id == self.node.id:
                 local_fn()
                 applied += 1
@@ -1555,7 +1639,8 @@ class Executor:
             if remote:
                 applied += 1  # forwarding node already counted the write
                 continue
-            res = self._forward_tolerant(node, forward_fn, errors, note)
+            res = self._forward_tolerant(node, forward_fn, errors, note,
+                                         hint=hint)
             if res is None:
                 continue
             applied += 1
@@ -1563,11 +1648,7 @@ class Executor:
                 on_forward_ok(res if res is not True else None)
         if app_error[0] is not None:
             raise app_error[0]
-        if applied == 0:
-            raise QueryError(
-                f"write failed on all owners of {index}/shard {shard}: "
-                + "; ".join(errors)
-            )
+        return applied, len(owners), errors
 
     def tolerant_group_fanout(self, index: str, shards, remote: bool,
                               apply_local, send_remote,
@@ -1582,8 +1663,20 @@ class Executor:
         keep-alive connection while different nodes (and local applies)
         proceed concurrently. `workers` caps how much of the shared pool
         one import may occupy, so a huge load can't starve query fan-out
-        of threads. apply_local(shard) / send_remote(node, shard)."""
+        of threads. apply_local(shard) / send_remote(node, shard).
+
+        Hinted handoff + consistency: local applies run under hint
+        capture (core/fragment.py), and the local wave completes BEFORE
+        any remote forward is attempted — a forward that then misses
+        enqueues the shard's captured op batch for the dead peer (a shard
+        with no local replica degrades to a sync-priority marker). After
+        the loop, the same [replication] write-consistency gate as the
+        single-shard fan-out applies PER SHARD: any shard under its level
+        raises a typed retryable 503 (hints already enqueued, no
+        rollback)."""
         import threading
+
+        from .core.fragment import capture_hint_ops
 
         # Placement resolved up front: one routing decision per import.
         plan = {int(s): self.cluster.shard_nodes(index, int(s)) for s in shards}
@@ -1600,6 +1693,7 @@ class Executor:
         applied = {s: 0 for s in plan}
         errors: List[str] = []
         app_error: List[Optional[Exception]] = [None]
+        captured: Dict[int, list] = {}  # shard -> [(frag, op_bytes)]
         mu = threading.Lock()
 
         local_shards: List[int] = []
@@ -1614,8 +1708,10 @@ class Executor:
                     node_work.setdefault(node.id, (node, []))[1].append(shard)
 
         def run_local(shard):
+            rec: list = []
             try:
-                apply_local(shard)
+                with capture_hint_ops(rec):
+                    apply_local(shard)
             except Exception as e:
                 # Local failures are deterministic (validation, storage
                 # fault): surface after the loop like a replica's 4xx, so
@@ -1625,58 +1721,92 @@ class Executor:
                     errors.append(f"local/shard {shard}: {e}")
                 return
             with mu:
+                captured[shard] = rec
                 applied[shard] += 1
 
         def note_app_error(e):
             with mu:
                 app_error[0] = app_error[0] or e
 
+        def hint_for(shard):
+            def hint(node):
+                if self.hints is None:
+                    return False
+                with mu:
+                    rec = captured.get(shard)
+                return self.hints.add(node.id, index, shard, rec)
+            return hint
+
         def run_node(node, shard_list):
             # The per-target tolerance step is _forward_tolerant — the
             # SAME implementation tolerant_owner_fanout uses, so the two
-            # fan-outs cannot drift apart on breaker/4xx semantics.
+            # fan-outs cannot drift apart on breaker/4xx/hint semantics.
             for shard in shard_list:
                 local_errs: List[str] = []
                 res = self._forward_tolerant(
                     node, lambda n, s=shard: send_remote(n, s),
-                    local_errs, note_app_error, what=f"/shard {shard}")
+                    local_errs, note_app_error, what=f"/shard {shard}",
+                    hint=hint_for(shard))
                 with mu:
                     errors.extend(local_errs)
                     if res is not None:
                         applied[shard] += 1
 
-        tasks = [(run_local, (s,)) for s in local_shards]
-        tasks += [(run_node, nw) for nw in node_work.values()]
-        if self._pool is None or workers <= 1 or len(tasks) <= 1:
-            for fn, args in tasks:
-                fn(*args)
-        else:
-            # Bounded waves rather than one submit-all: `workers` caps
-            # this import's occupancy of the shared pool.
-            cap = max(1, workers)
-            for i in range(0, len(tasks), cap):
-                futs = [self._pool.submit(fn, *args)
-                        for fn, args in tasks[i:i + cap]]
-                for f in futs:
-                    f.result()  # worker exceptions were captured inside
+        # Two waves — all local applies, THEN remote forwards: a forward
+        # can only hint op bytes its shard's local apply has already
+        # captured. Locals still parallelize among themselves and per-peer
+        # streams still overlap each other; only the local->remote overlap
+        # is given up, and that was already bounded by `workers` waves.
+        for tasks in ([(run_local, (s,)) for s in local_shards],
+                      [(run_node, nw) for nw in node_work.values()]):
+            if self._pool is None or workers <= 1 or len(tasks) <= 1:
+                for fn, args in tasks:
+                    fn(*args)
+            else:
+                # Bounded waves rather than one submit-all: `workers` caps
+                # this import's occupancy of the shared pool.
+                cap = max(1, workers)
+                for i in range(0, len(tasks), cap):
+                    futs = [self._pool.submit(fn, *args)
+                            for fn, args in tasks[i:i + cap]]
+                    for f in futs:
+                        f.result()  # worker exceptions captured inside
 
         if app_error[0] is not None:
             raise app_error[0]
-        failed = sorted(s for s, n in applied.items() if n == 0)
-        if failed:
-            raise QueryError(
-                f"import failed on all owners of {index}/shards {failed}: "
-                + "; ".join(errors)
+        if remote:
+            # Forwarded leg: the coordinator owns level accounting.
+            return
+        from .errors import WriteConsistencyError
+
+        under = sorted(
+            s for s, n in applied.items()
+            if n < self._write_required(len(plan[s])))
+        if under:
+            self._count_stat("WriteConsistencyUnmet")
+            raise WriteConsistencyError(
+                f"import applied under level {self._write_level()!r} on "
+                f"{index}/shards {under}: " + "; ".join(errors),
+                level=self._write_level(),
             )
 
     def _for_shard_owners(self, index: str, c: Call, shard: int, opt: ExecOptions, local_fn):
         """Apply a PQL write locally and forward to other owners — the
-        shared tolerant fan-out with query_node as the transport."""
+        shared tolerant fan-out with query_node as the transport. The
+        local apply runs under a hint capture (core/fragment.py), so a
+        missed forward hands the peer's hint log the exact WAL op bytes
+        this write produced — every view the write touched (standard plus
+        time-quantum views) rides along with no re-derivation."""
+        from .core.fragment import capture_hint_ops
+
         out = {"ret": False}
+        captured: list = []
 
         def local():
-            if local_fn():
-                out["ret"] = True
+            captured.clear()  # cutover retries must not double the batch
+            with capture_hint_ops(captured):
+                if local_fn():
+                    out["ret"] = True
 
         def forward(node):
             return self.client.query_node(node, index, str(c), remote=True)
@@ -1685,8 +1815,14 @@ class Executor:
             if res and isinstance(res[0], bool):
                 out["ret"] = out["ret"] or res[0]
 
+        def hint(node):
+            if self.hints is None:
+                return False
+            return self.hints.add(node.id, index, shard, captured)
+
         self.tolerant_owner_fanout(
-            index, shard, opt.remote, local, forward, on_forward_ok=note
+            index, shard, opt.remote, local, forward, on_forward_ok=note,
+            hint=hint,
         )
         return out["ret"]
 

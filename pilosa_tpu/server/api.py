@@ -226,10 +226,27 @@ class API:
                         remote: bool) -> None:
         """Bulk imports ride the executor's shared tolerant owner fan-out
         (one source of truth for the cluster's write-tolerance policy:
-        dead replicas skipped + marked, deterministic rejections surfaced
-        after the loop, failure only when no owner applied)."""
+        dead replicas hinted or skipped + marked, deterministic rejections
+        surfaced after the loop, the [replication] consistency level
+        gating the ack). The local apply runs under hint capture so a
+        missed replica forward enqueues this batch's exact WAL op bytes."""
+        from ..core.fragment import capture_hint_ops
+
+        captured: list = []
+
+        def local():
+            captured.clear()  # cutover retries must not double the batch
+            with capture_hint_ops(captured):
+                apply_local()
+
+        def hint(node):
+            hints = self.executor.hints
+            if hints is None:
+                return False
+            return hints.add(node.id, index, shard, captured)
+
         self.executor.tolerant_owner_fanout(
-            index, shard, remote, apply_local, send_remote
+            index, shard, remote, local, send_remote, hint=hint
         )
 
     def import_bits(self, index: str, field: str, shard: int, row_ids, column_ids,
@@ -461,6 +478,28 @@ class API:
             frag.set_bit(int(row), int(col))
         for row, col in clears:
             frag.clear_bit(int(row), int(col))
+
+    def apply_hint_ops(self, index: str, field: str, view: str, shard: int,
+                       data: bytes) -> None:
+        """Hinted-handoff delivery target (cluster/hints.py): replay a
+        shipped run of WAL op records — the coordinator's byte-exact
+        capture of a write this replica missed — into the addressed
+        fragment. Creates the view/fragment if this replica never saw
+        them (it was down when the write landed), like apply_block_diff.
+        Replay is idempotent set/clear, so redelivery after a crashed
+        checkpoint is harmless."""
+        from ..storage.bitmap import decode_op_records
+
+        fld = self.holder.field(index, field)
+        if fld is None:
+            from ..errors import FieldNotFoundError
+
+            raise FieldNotFoundError(f"{index}/{field}")
+        records = decode_op_records(data)  # raises typed on a torn stream
+        v = fld.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(shard, broadcast=False)
+        for adds, removes in records:
+            frag.apply_hint_positions(adds, removes)
 
     def fragment_block_data(self, index: str, field: str, view: str, shard: int, block: int) -> dict:
         frag = self.holder.fragment(index, field, view, shard)

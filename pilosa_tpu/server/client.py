@@ -525,6 +525,17 @@ class InternalClient:
         body = json.dumps({"sets": sets, "clears": clears}).encode()
         self._request("POST", url, body)
 
+    def send_hint_ops(self, node, index: str, field: str, view: str,
+                      shard: int, data: bytes) -> None:
+        """Deliver one hinted-handoff record (cluster/hints.py): a raw
+        run of storage/bitmap.py WAL op records the peer replays into the
+        addressed fragment. Idempotent on the receiver, so the client's
+        fresh-connection send retry is safe here like everywhere else."""
+        url = (f"{_node_url(node)}/internal/fragment/hints?"
+               f"index={index}&field={field}&view={view}&shard={shard}")
+        self._request("POST", url, data,
+                      content_type="application/octet-stream")
+
     def block_data(self, node, index: str, field: str, view: str, shard: int, block: int) -> dict:
         url = (f"{_node_url(node)}/internal/fragment/block/data?"
                f"index={index}&field={field}&view={view}&shard={shard}&block={block}")

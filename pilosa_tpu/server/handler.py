@@ -128,6 +128,7 @@ class Handler:
             Route("GET", r"/internal/translate/data", self.handle_translate_data),
             Route("POST", r"/internal/index/(?P<index>[^/]+)/attr/diff", self.handle_index_attr_diff),
             Route("POST", r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/attr/diff", self.handle_field_attr_diff),
+            Route("POST", r"/internal/fragment/hints", self.handle_post_hint_ops),
             Route("GET", r"/debug/vars", self.handle_debug_vars),
             Route("GET", r"/debug/traces", self.handle_debug_traces),
             Route("GET", r"/metrics", self.handle_metrics),
@@ -190,6 +191,19 @@ class Handler:
                     # request.
                     return (503, "application/json",
                             json.dumps({"error": str(e)}).encode())
+                from ..errors import WriteConsistencyError
+
+                if isinstance(e, WriteConsistencyError):
+                    # Degraded write path (too few live owners for the
+                    # configured [replication] write-consistency level, or
+                    # total owner loss): RETRYABLE 503, not a 400 — the
+                    # request is fine, the cluster is degraded. The
+                    # applied copies stand (no rollback) and hints were
+                    # enqueued before this surfaced, so a client retry
+                    # after Retry-After re-applies idempotent ops.
+                    return (503, "application/json",
+                            json.dumps({"error": str(e)}).encode(),
+                            {"Retry-After": "1"})
                 from ..errors import ShardMovedError, StaleRoutingEpochError
 
                 if isinstance(e, (ShardMovedError, StaleRoutingEpochError)):
@@ -568,6 +582,15 @@ class Handler:
             int(query["shard"][0]), int(query["block"][0]),
         )
 
+    def handle_post_hint_ops(self, query, body, **kw):
+        """Hinted-handoff delivery (cluster/hints.py): the body is a raw
+        run of storage/bitmap.py WAL op records for one fragment."""
+        self.api.apply_hint_ops(
+            query["index"][0], query["field"][0], query["view"][0],
+            int(query["shard"][0]), body,
+        )
+        return {}
+
     def handle_post_block_data(self, query, body, **kw):
         data = _json_body(body)
         self.api.apply_block_diff(
@@ -773,6 +796,14 @@ class Handler:
             rb["active"] = cluster.next_nodes is not None
             rb["migrated_shards"] = len(cluster.migrated)
             out["rebalance"] = rb
+        # Durable write replication (docs/durability.md "Write-path
+        # consistency"): configured ack level, per-peer pending hint
+        # backlog, append/deliver/expire counters — the on-call question
+        # after a replica outage is "are the missed writes queued and
+        # draining, or waiting on the anti-entropy backstop".
+        hints = getattr(self.api.server, "hints", None)
+        if hints is not None:
+            out["replication"] = hints.snapshot()
         # Per-query tracing health (docs/observability.md): sampler
         # counters, ring depth, slow-query count — the aggregate next to
         # the per-trace detail /debug/traces serves.

@@ -43,6 +43,8 @@ class Server:
         replica_n: int = 1,
         hasher=None,
         anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL,
+        anti_entropy_jitter: float = 0.1,
+        anti_entropy_pace: float = 0.0,
         cache_flush_interval: float = DEFAULT_CACHE_FLUSH_INTERVAL,
         metric_poll_interval: float = DEFAULT_METRIC_POLL_INTERVAL,
         long_query_time: float = 0.0,
@@ -59,6 +61,7 @@ class Server:
         coordinator_failover_probes: int = 3,
         resilience_config=None,
         rebalance_config=None,
+        replication_config=None,
         internal_key_path: Optional[str] = None,
         scheduler_config=None,
         storage_config=None,
@@ -93,6 +96,20 @@ class Server:
         self.stats = stats or InMemoryStatsClient()
         self.long_query_time = long_query_time
         self.anti_entropy_interval = anti_entropy_interval
+        # De-stampeding ([anti-entropy] jitter/pace): every node of a
+        # restarted cluster used to start an identical fixed-interval
+        # sweep timer at the same instant, so sweeps (full-holder block-
+        # checksum walks against every replica) landed cluster-wide
+        # simultaneously, forever. The jitter fraction desynchronizes
+        # both the first sweep and the steady-state period; `pace`
+        # sleeps between per-fragment syncs so one sweep cannot saturate
+        # peers with back-to-back block RPCs.
+        # Clamped to [0, 1]: jitter is a FRACTION of the interval. An
+        # operator's percent-vs-fraction slip (jitter=20) would otherwise
+        # make the steady-state wait negative — i.e. back-to-back sweeps,
+        # the exact stampede the knob exists to prevent.
+        self.anti_entropy_jitter = min(max(anti_entropy_jitter, 0.0), 1.0)
+        self.anti_entropy_pace = max(0.0, anti_entropy_pace)
         self.cache_flush_interval = cache_flush_interval
         self.member_monitor_interval = member_monitor_interval
         # Flap damping: consecutive failed heartbeat probes before the
@@ -202,6 +219,24 @@ class Server:
         # Writes racing a live-rebalance cutover re-route/wait up to this
         # long for the commit broadcast before failing clean.
         self.executor.cutover_wait = self.rebalance_config.cutover_pause_max
+        # Durable write replication (cluster/hints.py, docs/durability.md
+        # "Write-path consistency"): per-peer hint logs under the data
+        # dir catch writes a replica missed (breaker open / transport
+        # failure), a background daemon replays them when the peer
+        # returns, and the [replication] write-consistency level gates
+        # write acks. The store rides the [storage] fsync policy so a
+        # hint's durability matches the WAL's.
+        from ..cluster.hints import HintStore, ReplicationConfig
+
+        self.replication_config = (
+            replication_config or ReplicationConfig()).validate()
+        self.hints = HintStore(
+            os.path.join(data_dir, "hints") if data_dir else None,
+            config=self.replication_config,
+            storage_config=storage_config,
+        )
+        self.executor.hints = self.hints
+        self.executor.replication_config = self.replication_config
         # Query scheduler (sched/): admission control + deadlines +
         # cross-query micro-batching, the gate between the HTTP handler
         # and the executor. The batcher pulls the engine LAZILY so
@@ -394,7 +429,13 @@ class Server:
             self.cluster.state = STATE_NORMAL
 
         if self.anti_entropy_interval > 0 and self.cluster.replica_n > 1:
-            self._spawn(self._monitor_anti_entropy, self.anti_entropy_interval)
+            # Jittered: a cluster restart must not stampede every node's
+            # sweep onto the same instant (see anti_entropy_jitter above).
+            self._spawn(self._monitor_anti_entropy, self.anti_entropy_interval,
+                        jitter=self.anti_entropy_jitter)
+        if self.replication_config.deliver_interval > 0:
+            self._spawn(self._monitor_hints,
+                        self.replication_config.deliver_interval)
         if self.cache_flush_interval > 0:
             self._spawn(self._monitor_cache_flush, self.cache_flush_interval)
         if self.metric_poll_interval > 0:
@@ -636,13 +677,34 @@ class Server:
         # keep-alive pools; the probe client has its own.
         self.executor.close()
         self._probe_client.close()
+        self.hints.close()
         self.holder.close()
         self.translate_store.close()
         self.opened = False
 
-    def _spawn(self, fn, interval: float) -> None:
+    def _spawn(self, fn, interval: float, jitter: float = 0.0) -> None:
+        """Run `fn` every `interval` seconds on a daemon thread. `jitter`
+        (a fraction of the interval) desynchronizes a fleet: the first
+        wait starts anywhere in [0, interval*(1+jitter)] and every later
+        period varies by ±jitter, so identically-configured nodes
+        restarted together drift apart instead of firing in lockstep."""
+        import random
+
         def loop():
-            while not self._stop.wait(interval):
+            first = True
+            while True:
+                wait = interval
+                if jitter > 0:
+                    if first:
+                        wait = random.uniform(0, interval * (1.0 + jitter))
+                    else:
+                        wait = interval * (
+                            1.0 + random.uniform(-jitter, jitter))
+                first = False
+                # Event.wait(negative) returns immediately — never let a
+                # mis-set jitter turn the timer into a busy loop.
+                if self._stop.wait(max(wait, 0.0)):
+                    return
                 try:
                     fn()
                 except Exception as e:  # pragma: no cover - monitor resilience
@@ -664,6 +726,15 @@ class Server:
 
     def _monitor_cache_flush(self) -> None:
         self.holder.flush_caches()
+
+    def _monitor_hints(self) -> None:
+        """Hinted-handoff delivery sweep (cluster/hints.py): replay
+        pending per-peer hint logs toward peers whose breakers admit a
+        request. Backoff between retries IS the peer's breaker backoff,
+        and a delivery success doubles as the half-open probe that
+        re-closes it."""
+        self.hints.deliver_once(self.cluster, self.client,
+                                logger=self.logger)
 
     def _monitor_diagnostics(self) -> None:
         """Periodic telemetry flush + best-effort version check

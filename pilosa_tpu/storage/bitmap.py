@@ -1436,6 +1436,66 @@ def _apply_op_stream(b: "Bitmap", data, ops_offset: int) -> int:
     return ops_offset
 
 
+class _OpRecordSink:
+    """Bitmap-protocol shim for _apply_op_stream: instead of mutating a
+    bitmap, collect each replayed record's (adds, removes) position
+    arrays IN ORDER. Lets hint delivery (cluster/hints.py) decode a
+    shipped op run through THE one replayer — same framing, same torn-
+    tail rules — and apply it record-by-record via fragment-level calls
+    that keep WAL/journal/epoch semantics."""
+
+    __slots__ = ("records", "op_n", "_adds")
+
+    def __init__(self):
+        self.records = []  # [(adds, removes)] per record, in order
+        self.op_n = 0
+        self._adds = None
+
+    def _flush(self):
+        if self._adds is not None:
+            self.records.append((self._adds, _EMPTY_U8))
+            self._adds = None
+
+    def add_many(self, pos):
+        self._flush()
+        self._adds = np.asarray(pos, dtype=np.uint64)
+
+    def remove_many(self, pos):
+        # _apply_op_stream pairs add_many + remove_many per OP_BULK record.
+        adds = self._adds if self._adds is not None else _EMPTY_U8
+        self._adds = None
+        self.records.append((adds, np.asarray(pos, dtype=np.uint64)))
+
+    def apply_op(self, typ, value):
+        self._flush()
+        one = np.asarray([value], dtype=np.uint64)
+        if typ == OP_ADD:
+            self.records.append((one, _EMPTY_U8))
+        elif typ == OP_REMOVE:
+            self.records.append((_EMPTY_U8, one))
+        else:
+            raise CorruptFragmentError(f"invalid op type: {typ}")
+        return True
+
+
+_EMPTY_U8 = np.zeros(0, dtype=np.uint64)
+
+
+def decode_op_records(data: bytes):
+    """Decode a shipped run of WAL records into ordered (adds, removes)
+    position-array pairs. Strict like replay_ops: a stream that does not
+    parse whole is a transport/sender fault and raises, never a silent
+    partial apply."""
+    sink = _OpRecordSink()
+    end = _apply_op_stream(sink, data, 0)
+    sink._flush()
+    if end != len(data):
+        raise CorruptFragmentError(
+            f"torn hint op stream: {len(data) - end} trailing bytes "
+            "unparseable", offset=end)
+    return sink.records
+
+
 def replay_ops(b: "Bitmap", data: bytes) -> None:
     """Apply a SHIPPED run of WAL records (a migration catch-up tail) to
     `b`. Unlike a local reopen — where a torn FINAL record is an expected

@@ -96,7 +96,7 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     assert not detail.get("partial"), detail.get("partial")
     assert parsed["value"] > 0
     stanzas = _registered_stanzas()
-    assert len(stanzas) >= 16  # the registry itself didn't shrink
+    assert len(stanzas) >= 19  # the registry itself didn't shrink
     for name in stanzas:
         stanza = detail.get(name.lower())
         assert isinstance(stanza, dict), f"stanza {name} missing: {stanza}"
@@ -116,6 +116,25 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     fault = detail["fault"]
     assert fault["recovered"], fault
     assert fault["recovery_s"] < 30, fault
+    # The REPLICATION stanza is the durable-write-replication acceptance
+    # metric (docs/durability.md "Write-path consistency"): across a
+    # replica SIGKILL + restart under write-consistency=quorum, ZERO
+    # acked writes may be lost and the restarted replica's fragments
+    # must be byte-identical after the hint drain; during the outage
+    # every write must meet quorum with missed forwards costing a hint
+    # append (counters prove the breaker-open path never pays a connect
+    # timeout per write). All correctness gates — never retried. The
+    # hint-drain timing gate gets the standard one-shot isolation rerun.
+    repl = detail["replication"]
+    assert repl["lost_acked_writes"] == 0, repl
+    assert repl["byte_identical"], repl
+    assert repl["hinted_ok"], repl
+    assert repl["outage_counters"]["WriteConsistencyUnmet"] == 0, repl
+    repl = _retry_ratio_gate(
+        "REPLICATION", repl,
+        lambda r: r["drained"] and r["hint_drain_s"] < 30, tmp_path)
+    assert repl["drained"], repl
+    assert repl["hint_drain_s"] < 30, repl
     # The DEGRADE stanza is the device-fault acceptance metric: with
     # every engine dispatch failing, the degraded phase must serve with
     # ZERO query errors and bit-exact results (the host ladder), injected

@@ -91,6 +91,24 @@ def chaos_cluster(tmp_path):
             pass
 
 
+def _rq(client, h0, q, deadline_s=20.0):
+    """Query with transport-flake tolerance for the NO-FAULT phases
+    (load, convergence): under full-suite box load a 10s socket timeout
+    can trip with zero injected faults, which used to fail the smoke
+    outright (known flake since PR 10). A transport-shaped error
+    (status 0 — timeout, connect failure) retries within a bounded
+    deadline; an application error (4xx/5xx) or wrong data still
+    surfaces immediately, so the correctness contract is untouched."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return client.query(h0, "cx", q)
+        except ClientError as e:
+            if getattr(e, "status", 0) != 0 or time.monotonic() >= deadline:
+                raise
+            time.sleep(0.1)
+
+
 def _load(client, h0):
     """Deterministic dataset spanning every shard; returns expected
     Count(Row(f=r)) per row. Idempotent: the randomized sweep replays it
@@ -103,11 +121,11 @@ def _load(client, h0):
         cols = [s * SHARD_WIDTH + 17 * row + k for s in range(N_SHARDS)
                 for k in range(row)]
         for col in cols:
-            client.query(h0, "cx", f"Set({col}, f={row})")
+            _rq(client, h0, f"Set({col}, f={row})")
         expected[row] = len(set(cols))
     # Sanity before faults.
     for row, want in expected.items():
-        assert client.query(h0, "cx", f"Count(Row(f={row}))")["results"][0] == want
+        assert _rq(client, h0, f"Count(Row(f={row}))")["results"][0] == want
     return expected
 
 
@@ -161,7 +179,7 @@ def _run_chaos(servers, hosts, clock, seed, rounds, queries_per_round):
             )
         assert s.cluster.unavailable == set()
     for row, want in expected.items():
-        got = client.query(h0, "cx", f"Count(Row(f={row}))")
+        got = _rq(client, h0, f"Count(Row(f={row}))")
         assert got["results"][0] == want
     # Zero degraded reads after recovery: nothing quarantined, nothing
     # served from an empty fragment.

@@ -487,6 +487,58 @@ def test_injected_crash_mid_bulk_append_torn_tail(tmp_path):
     frag.close()
 
 
+def test_sigkill_mid_hint_append_truncates_torn_tail(tmp_path):
+    """Hinted-handoff durability twin of the WAL kill -9 contract
+    (cluster/hints.py): the parent SIGKILLs a writer appending hint
+    records at an arbitrary acked point. After reopen, every ACKED hint
+    is present in order and parseable; a torn tail (the mid-append
+    artifact, plus hand-written garbage) truncates at the last whole
+    record and is NEVER replayed toward a peer."""
+    hints_dir = str(tmp_path / "hints")
+    child = _run_child("""
+        from pilosa_tpu.cluster.hints import HintStore, ReplicationConfig
+        from pilosa_tpu.storage.bitmap import encode_op, OP_ADD
+
+        class F:
+            index = "i"; field = "f"; view = "standard"; shard = 0
+        hs = HintStore(sys.argv[1], ReplicationConfig())
+        for i in range(100_000):
+            assert hs.add("peer-a:1", "i", 0, [(F, encode_op(OP_ADD, i))])
+            print(i, flush=True)  # the ack
+    """, hints_dir)
+    acked = -1
+    try:
+        for line in child.stdout:
+            acked = int(line)
+            if acked >= 150:
+                break
+    finally:
+        child.kill()
+        child.wait(timeout=30)
+    assert acked >= 150
+    from pilosa_tpu.cluster.hints import HintStore, ReplicationConfig
+    from pilosa_tpu.storage.bitmap import decode_op_records
+
+    hs = HintStore(hints_dir, ReplicationConfig())
+    recs = hs.records("peer-a:1")
+    assert len(recs) >= acked + 1, f"lost acked hints: {len(recs)}/{acked+1}"
+    for i, rec in enumerate(recs[: acked + 1]):
+        adds, rems = decode_op_records(rec.ops)[0]
+        assert adds.tolist() == [i] and not len(rems)
+    hs.close()
+    # Tear the tail by hand on top: reopen truncates, counts it, and
+    # the surviving prefix still parses whole.
+    log_path = os.path.join(hints_dir, "peer-a%3A1", "log")
+    whole = os.path.getsize(log_path)
+    with open(log_path, "ab") as fh:
+        fh.write(b"\x00\x01\x02garbage")
+    hs2 = HintStore(hints_dir, ReplicationConfig())
+    assert hs2.snapshot()["hints_truncated"] == 1
+    assert os.path.getsize(log_path) == whole
+    assert len(hs2.records("peer-a:1")) == len(recs)
+    hs2.close()
+
+
 def test_sigkill_mid_background_snapshot(tmp_path):
     """Crash at the BACKGROUND snapshot's rename boundary (the crash
     fires on the snapshotter thread; os._exit models kill -9): the

@@ -297,9 +297,10 @@ def test_import_tolerates_dead_replica(cluster3r):
 def test_write_fanout_replica_flap_converges(cluster3r, tmp_path):
     """tolerant_owner_fanout under a replica that flaps mid-write-stream
     (alive -> dead -> alive): the surviving owner applies every acked
-    write exactly once, skipped forwards are counted (breaker open, zero
-    connect attempts), and anti-entropy converges the flapped replica
-    back to byte-identical fragment state."""
+    write exactly once, missed forwards are HINTED (breaker open, zero
+    connect attempts — cluster/hints.py), the hint log drains to the
+    returned replica, and anti-entropy finds byte-identical fragment
+    state with nothing left to push."""
     import io
 
     from pilosa_tpu.cluster.health import CLOSED
@@ -332,19 +333,22 @@ def test_write_fanout_replica_flap_converges(cluster3r, tmp_path):
     assert client.query(h0, "flap", f"Set({base + 1}, f=9)")["results"][0]
 
     # Phase 2: replica dies mid-stream. The first write pays the failed
-    # forward; later writes skip without a connect attempt.
+    # forward and lands in the peer's hint log; later writes queue behind
+    # it (per-peer FIFO) without a connect attempt.
     flap_port, flap_dir = flapper.port, flapper.data_dir
     flapper.close()
     assert client.query(h0, "flap", f"Set({base + 2}, f=9)")["results"][0]
     assert counter("WriteForwardFailed") >= 1
+    assert counter("WriteForwardHinted") >= 1
     assert flap_id in s0.cluster.unavailable
-    skipped_before = counter("WriteForwardSkipped")
+    hinted_before = counter("WriteForwardHinted")
     assert client.query(h0, "flap", f"Set({base + 3}, f=9)")["results"][0]
-    assert counter("WriteForwardSkipped") > skipped_before
-    assert s0.cluster.health.counters["breaker_short_circuits"] >= 1
+    assert counter("WriteForwardHinted") > hinted_before
+    assert s0.hints.pending(flap_id) >= 2
 
     # Phase 3: replica returns (same id, same data dir). The monitor's
-    # successful probe recloses the breaker; writes forward again.
+    # successful probe recloses the breaker; the delivery daemon drains
+    # the hint log; writes forward directly again.
     flapper2 = Server(
         data_dir=flap_dir,
         port=flap_port,
@@ -361,6 +365,12 @@ def test_write_fanout_replica_flap_converges(cluster3r, tmp_path):
         s0._monitor_members()
         assert flap_id not in s0.cluster.unavailable
         assert s0.cluster.health.state(flap_id) == CLOSED
+        # The delivery daemon (deliver-interval default 1s) replays the
+        # missed Sets in order; poll until the backlog clears.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and s0.hints.pending(flap_id):
+            time.sleep(0.05)
+        assert s0.hints.pending(flap_id) == 0
         assert client.query(h0, "flap", f"Set({base + 4}, f=9)")["results"][0]
 
         # No double-apply on the surviving owner: exactly the 4 distinct
@@ -369,12 +379,13 @@ def test_write_fanout_replica_flap_converges(cluster3r, tmp_path):
         # replicas — both show up as a count mismatch somewhere below).
         frag0 = s0.holder.fragment("flap", "f", "standard", target_shard)
         assert frag0.row_count(9) == 4
-        # The flapped replica missed bits 2 and 3.
+        # The flapped replica got bits 2 and 3 from the hint drain and
+        # bit 4 as a direct forward — no anti-entropy sweep needed.
         fragX = flapper2.holder.fragment("flap", "f", "standard", target_shard)
-        assert fragX is not None and fragX.row_count(9) == 2
+        assert fragX is not None and fragX.row_count(9) == 4
 
-        # Phase 4: anti-entropy converges the flapped replica
-        # byte-identically with the survivor.
+        # Phase 4: anti-entropy finds nothing left to repair; state is
+        # byte-identical with the survivor.
         HolderSyncer(s0).sync_holder()
         time.sleep(0.05)
         fragX = flapper2.holder.fragment("flap", "f", "standard", target_shard)

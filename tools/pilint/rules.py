@@ -1464,6 +1464,8 @@ R11_SECTIONS: Dict[str, Tuple[str, str, str, str]] = {
                          "docs/fault-tolerance.md"),
     "RebalanceConfig": ("rebalance", "rebalance", "REBALANCE",
                         "docs/rebalance.md"),
+    "ReplicationConfig": ("replication", "replication", "REPLICATION",
+                          "docs/durability.md"),
     "ObsConfig": ("obs", "obs", "OBS", "docs/observability.md"),
 }
 CONFIG_FILE = "pilosa_tpu/config.py"
