@@ -1505,6 +1505,144 @@ def bench_replication():
     return out
 
 
+# ------------------------------------------------------------- CDC stanza
+
+
+def bench_cdc():
+    """Change-data-capture acceptance (docs/cdc.md): one node with change
+    capture on. tail: a consumer long-polls the change stream while the
+    writer streams Set() ops — per-record delivery lag (write ack ->
+    consumer decode), a dense-position proof (zero gaps or renumbers),
+    and a byte-exact replay of the streamed op bytes against the live
+    fragment. pit: at-position reads vs answers frozen at each
+    checkpoint, cold materialization vs the LRU-warm repeat. standing:
+    one registered Count must re-push within ONE evaluator sweep of a
+    write that changed its answer, and must NOT re-push for a write that
+    didn't."""
+    import shutil
+    import tempfile
+    import threading
+
+    from pilosa_tpu.cdc import CdcConfig
+    from pilosa_tpu.cdc.log import decode_cdc_records
+    from pilosa_tpu.server.server import Server
+    from pilosa_tpu.storage.bitmap import Bitmap, replay_ops
+
+    n_writes = 400 if SMOKE else 4000
+    tmp = tempfile.mkdtemp(prefix="bench-cdc-")
+    out = {"writes": n_writes}
+    s = Server(data_dir=tmp, cache_flush_interval=0,
+               member_monitor_interval=0,
+               cdc_config=CdcConfig(enabled=True, standing_interval=0))
+    s.holder.open()
+    try:
+        idx = s.holder.create_index("cdc")
+        idx.create_field("f")
+
+        # ---- tail: lag, dense positions, byte-exact replay
+        write_t = {1: time.perf_counter()}
+        s.api.query("cdc", "Set(0, f=1)")
+        frag = idx.fields["f"].views["standard"].fragments[0]
+        last = n_writes + 1
+        positions, lags = [], []
+        bm = Bitmap()
+        done = threading.Event()
+
+        def consume():
+            cur, inc = 0, None
+            while positions[-1:] != [last]:
+                data, cur, inc = s.cdc.stream("cdc", cur, inc, timeout=5)
+                now = time.perf_counter()
+                for rec, _ in decode_cdc_records(data):
+                    positions.append(rec.position)
+                    replay_ops(bm, rec.ops)
+                    lags.append(now - write_t[rec.position])
+            done.set()
+
+        t = threading.Thread(target=consume)
+        t.start()
+        t0 = time.perf_counter()
+        for i in range(n_writes):
+            write_t[i + 2] = time.perf_counter()
+            frag.set_bit(1, i + 1)
+        write_s = time.perf_counter() - t0
+        delivered = done.wait(timeout=120)
+        t.join(timeout=10)
+        lags.sort()
+        pick = lambda q: round(  # noqa: E731
+            lags[min(len(lags) - 1, int(len(lags) * q))] * 1e3, 3) \
+            if lags else None
+        out["tail"] = {
+            "delivered": len(positions),
+            "dense": positions == list(range(1, last + 1)),
+            "bit_exact": delivered
+            and bm.to_bytes() == frag.storage.to_bytes(),
+            "lag_p50_ms": pick(0.50),
+            "lag_p99_ms": pick(0.99),
+            "writes_per_s": round(n_writes / write_s, 1) if write_s else 0.0,
+        }
+
+        # ---- pit: frozen-twin answers, cold vs LRU-warm materialization
+        checkpoints = []
+        for b in range(4):
+            for i in range(25):
+                s.api.query("cdc", f"Set({b * 25 + i}, f=2)")
+            checkpoints.append((s.cdc.log("cdc").last_pos,
+                                int(s.api.query("cdc",
+                                                "Count(Row(f=2))")[0])))
+        exact = True
+        cold, warm = [], []
+        for pos, frozen in checkpoints:
+            q0 = time.perf_counter()
+            got = int(s.api.query("cdc", "Count(Row(f=2))",
+                                  at_position=pos)[0])
+            cold.append(time.perf_counter() - q0)
+            exact = exact and got == frozen
+            q0 = time.perf_counter()
+            again = int(s.api.query("cdc", "Count(Row(f=2))",
+                                    at_position=pos)[0])
+            warm.append(time.perf_counter() - q0)
+            exact = exact and again == frozen
+        pit = s.cdc.pit
+        out["pit"] = {
+            "bit_exact": exact,
+            "checkpoints": len(checkpoints),
+            "cold_ms_p50": round(sorted(cold)[len(cold) // 2] * 1e3, 3),
+            "warm_ms_p50": round(sorted(warm)[len(warm) // 2] * 1e3, 3),
+            "cache_hits": pit.hits, "cache_misses": pit.misses,
+        }
+
+        # ---- standing: re-push within one sweep, only on real change
+        sq, _ = s.cdc.standing.register("cdc", "Count(Row(f=1))")
+        s.cdc.standing.evaluate_once()  # prime the first result
+        v0 = sq.version
+        s.api.query("cdc", f"Set({n_writes + 10}, f=1)")
+        q0 = time.perf_counter()
+        s.cdc.standing.evaluate_once()
+        sweep_ms = (time.perf_counter() - q0) * 1e3
+        pushed = sq.version == v0 + 1
+        s.api.query("cdc", "Set(11, f=3)")  # unrelated row, epoch bumps
+        s.cdc.standing.evaluate_once()
+        unrelated_push = sq.version != v0 + 1
+        out["standing"] = {
+            "pushed_on_change": pushed,
+            "pushed_on_unrelated": unrelated_push,
+            "sweep_ms": round(sweep_ms, 3),
+            "evals": sq.evals, "pushes": sq.pushes, "stale": sq.stale,
+        }
+        out["cdc_ok"] = bool(
+            out["tail"]["dense"] and out["tail"]["bit_exact"]
+            and exact and pushed and not unrelated_push)
+    finally:
+        try:
+            s.cdc.close()
+            s.holder.close()
+        except Exception:
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # --------------------------------------- device-plane degradation stanza
 
 
@@ -3015,6 +3153,7 @@ STANZAS = (
     ("MIXED", bench_mixed),
     ("FAULT", bench_fault),
     ("REPLICATION", bench_replication),
+    ("CDC", bench_cdc),
     ("DEGRADE", bench_degrade),
     ("REBALANCE", bench_rebalance),
     ("TIER", bench_tier),

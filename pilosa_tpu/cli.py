@@ -183,6 +183,27 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    type=float,
                    help="log queries slower than this with their full "
                         "stage breakdown (0 disables the slow-query log)")
+    p.add_argument("--cdc-enabled", dest="cdc_enabled", type=int,
+                   metavar="{0,1}",
+                   help="1 turns on change data capture: per-index CDC "
+                        "streams, point-in-time reads, standing queries")
+    p.add_argument("--cdc-retention-bytes", dest="cdc_retention_bytes",
+                   type=int,
+                   help="per-index CDC log size that triggers folding the "
+                        "oldest records into base images (cursors behind "
+                        "the fold get 410)")
+    p.add_argument("--cdc-retention-ops", dest="cdc_retention_ops", type=int,
+                   help="per-index CDC log op count that triggers folding")
+    p.add_argument("--cdc-poll-timeout", dest="cdc_poll_timeout", type=float,
+                   help="default long-poll park time in seconds for "
+                        "/cdc/stream and standing-query polls")
+    p.add_argument("--cdc-standing-interval", dest="cdc_standing_interval",
+                   type=float,
+                   help="seconds between standing-query staleness sweeps "
+                        "(0 disables the background evaluator)")
+    p.add_argument("--cdc-pit-cache", dest="cdc_pit_cache", type=int,
+                   help="materialized historical fragments kept in the "
+                        "point-in-time LRU")
     p.add_argument("--sched-max-queue", dest="sched_max_queue", type=int,
                    help="bounded admission queue; full requests get 429")
     p.add_argument("--sched-interactive-concurrency",

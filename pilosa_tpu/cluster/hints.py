@@ -319,41 +319,28 @@ class HintStore:
         cursor = min(cursor, size)
         valid = cursor
         if scan and size > cursor:
-            # Stream the scan in bounded chunks (a long outage's backlog
-            # can be the full per-peer budget; loading it whole just to
-            # count pending records would spike startup RAM by the sum
-            # of every peer's log). A record spanning a chunk boundary
-            # leaves an undecoded tail that the next read extends;
-            # whatever tail remains at EOF is torn and truncates.
-            chunk_size = 8 << 20
+            # Bounded chunked scan shared with the CDC change log
+            # (storage/logscan.py): one reader, one set of torn-tail
+            # semantics — a record spanning a chunk boundary is extended
+            # by the next read, and whatever tail remains at EOF is torn
+            # and truncated to the last whole-record boundary.
+            from ..storage.logscan import scan_log
+
             now = self.clock()
-            with open(log.path, "rb") as f:
-                f.seek(cursor)
-                buf = b""
-                pos = cursor  # absolute offset of buf[0]
-                while True:
-                    chunk = f.read(chunk_size)
-                    buf += chunk
-                    consumed = 0
-                    for rec, end in decode_records(buf):
-                        consumed = end
-                        log.pending += 1
-                        key = (rec.index, rec.shard)
-                        log.shards[key] = log.shards.get(key, 0) + 1
-                        if rec.marker or \
-                                now - rec.created > self.config.hint_ttl:
-                            self._needs_sync.add(key)
-                    valid = pos + consumed
-                    if not chunk:
-                        break  # EOF: buf holds the (possibly torn) tail
-                    buf = buf[consumed:]
-                    pos += consumed
-            if valid < size:
+
+            def note(rec):
+                log.pending += 1
+                key = (rec.index, rec.shard)
+                log.shards[key] = log.shards.get(key, 0) + 1
+                if rec.marker or now - rec.created > self.config.hint_ttl:
+                    self._needs_sync.add(key)
+
+            res = scan_log(log.path, decode_records, start=cursor,
+                           on_record=note)
+            if res.truncated:
                 with self._mu:
                     self.counters["hints_truncated"] += 1
-                with open(log.path, "ab") as f:
-                    f.truncate(valid)
-                size = valid
+            size = res.valid
         log.size = size
         log.cursor = min(cursor, log.size)
         log.fh = open(log.path, "ab")

@@ -112,6 +112,11 @@ from .cluster.hints import ReplicationConfig  # noqa: E402
 # (pilosa_tpu/obs/, jax-free). See docs/observability.md.
 from .obs import ObsConfig  # noqa: E402
 
+# And for [cdc]: the change-capture knobs (stream retention, long-poll
+# bounds, standing-query cadence) live with the CDC subsystem
+# (pilosa_tpu/cdc/, jax-free). See docs/cdc.md.
+from .cdc import CdcConfig  # noqa: E402
+
 
 @dataclass
 class MetricConfig:
@@ -159,6 +164,7 @@ class Config:
     rebalance: RebalanceConfig = field(default_factory=RebalanceConfig)
     replication: ReplicationConfig = field(default_factory=ReplicationConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    cdc: CdcConfig = field(default_factory=CdcConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     translation: TranslationConfig = field(default_factory=TranslationConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
@@ -268,6 +274,17 @@ class Config:
         self.obs.ring_size = ob.get("ring-size", self.obs.ring_size)
         self.obs.slow_query_ms = ob.get(
             "slow-query-ms", self.obs.slow_query_ms)
+        cd = d.get("cdc", {})
+        self.cdc.enabled = cd.get("enabled", self.cdc.enabled)
+        self.cdc.retention_bytes = cd.get(
+            "retention-bytes", self.cdc.retention_bytes)
+        self.cdc.retention_ops = cd.get(
+            "retention-ops", self.cdc.retention_ops)
+        self.cdc.poll_timeout = cd.get(
+            "poll-timeout", self.cdc.poll_timeout)
+        self.cdc.standing_interval = cd.get(
+            "standing-interval", self.cdc.standing_interval)
+        self.cdc.pit_cache = cd.get("pit-cache", self.cdc.pit_cache)
         s = d.get("scheduler", {})
         self.scheduler.max_queue = s.get("max-queue", self.scheduler.max_queue)
         self.scheduler.interactive_concurrency = s.get(
@@ -456,6 +473,17 @@ class Config:
             if v is not None:
                 setattr(self.obs, attr, v)
         for attr, name, cast in [
+            ("enabled", "CDC_ENABLED", bool),
+            ("retention_bytes", "CDC_RETENTION_BYTES", int),
+            ("retention_ops", "CDC_RETENTION_OPS", int),
+            ("poll_timeout", "CDC_POLL_TIMEOUT", float),
+            ("standing_interval", "CDC_STANDING_INTERVAL", float),
+            ("pit_cache", "CDC_PIT_CACHE", int),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.cdc, attr, v)
+        for attr, name, cast in [
             ("max_queue", "SCHED_MAX_QUEUE", int),
             ("interactive_concurrency", "SCHED_INTERACTIVE_CONCURRENCY", int),
             ("batch_concurrency", "SCHED_BATCH_CONCURRENCY", int),
@@ -610,6 +638,12 @@ class Config:
             "obs_sample_rate": ("obs", "sample_rate"),
             "obs_ring_size": ("obs", "ring_size"),
             "obs_slow_query_ms": ("obs", "slow_query_ms"),
+            "cdc_enabled": ("cdc", "enabled"),
+            "cdc_retention_bytes": ("cdc", "retention_bytes"),
+            "cdc_retention_ops": ("cdc", "retention_ops"),
+            "cdc_poll_timeout": ("cdc", "poll_timeout"),
+            "cdc_standing_interval": ("cdc", "standing_interval"),
+            "cdc_pit_cache": ("cdc", "pit_cache"),
             "sched_max_queue": ("scheduler", "max_queue"),
             "sched_interactive_concurrency": ("scheduler", "interactive_concurrency"),
             "sched_batch_concurrency": ("scheduler", "batch_concurrency"),
@@ -739,6 +773,14 @@ class Config:
             f"ring-size = {self.obs.ring_size}",
             f"slow-query-ms = {self.obs.slow_query_ms}",
             "",
+            "[cdc]",
+            f"enabled = {fmt(self.cdc.enabled)}",
+            f"retention-bytes = {self.cdc.retention_bytes}",
+            f"retention-ops = {self.cdc.retention_ops}",
+            f"poll-timeout = {self.cdc.poll_timeout}",
+            f"standing-interval = {self.cdc.standing_interval}",
+            f"pit-cache = {self.cdc.pit_cache}",
+            "",
             "[scheduler]",
             f"max-queue = {self.scheduler.max_queue}",
             f"interactive-concurrency = {self.scheduler.interactive_concurrency}",
@@ -850,6 +892,7 @@ class Config:
             resilience_config=self.resilience.validate(),
             rebalance_config=self.rebalance.validate(),
             obs_config=self.obs.validate(),
+            cdc_config=self.cdc.validate(),
         )
         kw.update(overrides)
         return Server(**kw)

@@ -137,6 +137,7 @@ class Field:
         storage_config=None,
         delta_journal_ops=None,
         snapshotter=None,
+        cdc=None,
     ):
         validate_name(name)
         self.path = path
@@ -149,6 +150,7 @@ class Field:
         self.storage_config = storage_config
         self.delta_journal_ops = delta_journal_ops
         self.snapshotter = snapshotter
+        self.cdc = cdc
         self.views: Dict[str, View] = {}
         self.bsi_groups: List[BSIGroup] = []
         self._lock = threading.RLock()
@@ -226,6 +228,7 @@ class Field:
             storage_config=self.storage_config,
             delta_journal_ops=self.delta_journal_ops,
             snapshotter=self.snapshotter,
+            cdc=self.cdc,
         )
 
     def view(self, name: str) -> Optional[View]:

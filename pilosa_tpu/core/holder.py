@@ -20,12 +20,16 @@ from .index import Index, IndexOptions
 
 class Holder:
     def __init__(self, path: Optional[str] = None, stats=None, broadcast_shard=None,
-                 storage_config=None, delta_journal_ops=None):
+                 storage_config=None, delta_journal_ops=None, cdc=None):
         self.path = path
         self.stats = stats
         self.broadcast_shard = broadcast_shard
         self.storage_config = storage_config
         self.delta_journal_ops = delta_journal_ops
+        # CDC change-stream manager (cdc/manager.py), threaded down
+        # Holder -> Index -> Field -> View -> Fragment like the
+        # snapshotter. None = change capture off (the default).
+        self.cdc = cdc
         self.indexes: Dict[str, Index] = {}
         self._lock = threading.RLock()
         self.opened = False
@@ -63,9 +67,14 @@ class Holder:
                     storage_config=self.storage_config,
                     delta_journal_ops=self.delta_journal_ops,
                     snapshotter=self.snapshotter,
+                    cdc=self.cdc,
                 )
                 index.open()
                 self.indexes[name] = index
+                if self.cdc is not None:
+                    # Cut/refresh point-in-time base images for data that
+                    # predates change capture (cdc/log.py base model).
+                    self.cdc.register_index(index)
         if self.snapshotter is not None:
             self.snapshotter.start()
         self.opened = True
@@ -114,10 +123,13 @@ class Holder:
             storage_config=self.storage_config,
             delta_journal_ops=self.delta_journal_ops,
             snapshotter=self.snapshotter,
+            cdc=self.cdc,
         )
         index.open()
         index.save_meta()
         self.indexes[name] = index
+        if self.cdc is not None:
+            self.cdc.register_index(index)
         return index
 
     def delete_index(self, name: str) -> None:
@@ -128,6 +140,11 @@ class Holder:
             index.close()
             if index.path and os.path.isdir(index.path):
                 shutil.rmtree(index.path)
+            if self.cdc is not None:
+                # Drop the change log WITH the index: a recreated index
+                # gets a fresh incarnation, so a consumer's stale cursor
+                # can never alias the new position sequence (410 instead).
+                self.cdc.drop_index(name)
 
     def index_names(self) -> List[str]:
         return sorted(self.indexes)

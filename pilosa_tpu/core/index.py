@@ -42,6 +42,7 @@ class Index:
         storage_config=None,
         delta_journal_ops=None,
         snapshotter=None,
+        cdc=None,
     ):
         validate_name(name)
         self.path = path
@@ -52,6 +53,7 @@ class Index:
         self.storage_config = storage_config
         self.delta_journal_ops = delta_journal_ops
         self.snapshotter = snapshotter
+        self.cdc = cdc
         # Index-wide write epoch: every fragment mutation in this index
         # bumps it (core/fragment.py WriteEpoch). The query micro-batcher
         # keys coalescing groups on it so a batch never mixes queries
@@ -91,6 +93,7 @@ class Index:
                     storage_config=self.storage_config,
                     delta_journal_ops=self.delta_journal_ops,
                     snapshotter=self.snapshotter,
+                    cdc=self.cdc,
                 )
                 field.open()
                 self.fields[fname] = field
@@ -140,6 +143,7 @@ class Index:
             storage_config=self.storage_config,
             delta_journal_ops=self.delta_journal_ops,
             snapshotter=self.snapshotter,
+            cdc=self.cdc,
         )
         field.open()
         field.save_meta()

@@ -32,6 +32,7 @@ class View:
         storage_config=None,
         delta_journal_ops=None,
         snapshotter=None,
+        cdc=None,
     ):
         self.path = path
         self.index = index
@@ -46,6 +47,7 @@ class View:
         self.storage_config = storage_config
         self.delta_journal_ops = delta_journal_ops
         self.snapshotter = snapshotter
+        self.cdc = cdc
         self.fragments: Dict[int, Fragment] = {}
         self._lock = threading.RLock()
 
@@ -87,6 +89,7 @@ class View:
             storage_config=self.storage_config,
             delta_journal_ops=self.delta_journal_ops,
             snapshotter=self.snapshotter,
+            cdc=self.cdc,
         )
 
     def fragment(self, shard: int) -> Optional[Fragment]:

@@ -126,6 +126,23 @@ class WriteConsistencyError(PilosaError):
         self.applied = applied
 
 
+class CdcGoneError(PilosaError):
+    """A CDC cursor (stream resume point, point-in-time position, or
+    bootstrap baseline) fell behind retention, or presents the
+    incarnation of a deleted+recreated index whose positions restarted.
+    Maps to HTTP 410 GONE — NOT retryable at the same cursor: the
+    consumer must re-bootstrap from a fragment snapshot
+    (GET /cdc/bootstrap) and resume from the position it was cut at."""
+
+    message = "cdc position gone"
+
+    def __init__(self, *args, first=None, last=None, incarnation=None):
+        super().__init__(*args)
+        self.first = first              # oldest retained position, when known
+        self.last = last                # newest assigned position, when known
+        self.incarnation = incarnation  # the log's current incarnation
+
+
 class CorruptFragmentError(PilosaError, ValueError):
     """On-disk fragment/bitmap data failed validation (bad cookie, bogus
     container payload, checksum-failing op record). Carries where the file

@@ -98,6 +98,41 @@ class ExecOptions:
     # call of a multi-call query — is still detected. Anchoring inside
     # _fan_out would capture a post-cutover epoch and miss the GC.
     entry_epoch: Optional[int] = None
+    # Point-in-time read (cdc/): execute against fragments materialized
+    # at this CDC position (base image + op replay, cdc/pit.py) instead
+    # of live storage. Read-only, node-local, requires cdc.enabled.
+    at_position: Optional[int] = None
+
+
+class _NoDeviceHealth:
+    """Ladder stub for the shadow executor: never route to the device."""
+
+    @staticmethod
+    def plan(sig):
+        return "shard"
+
+
+class _NoDeviceEngine:
+    """Engine stub installed on the point-in-time shadow executor
+    (_execute_at_position): refuses every fast-path gate, forcing the
+    host per-shard map/reduce walk. Historical fragments are pathless
+    one-shot materializations — pushing them through the device engine
+    would enroll frozen snapshots in resident-stack/generation tracking
+    keyed by (index, field, view, shard), colliding with the LIVE
+    fragments of the same coordinates."""
+
+    device_health = _NoDeviceHealth()
+
+    @staticmethod
+    def supports(call, index=None):
+        return False
+
+    @staticmethod
+    def host_supports(call):
+        return False
+
+
+_NO_DEVICE_ENGINE = _NoDeviceEngine()
 
 
 @dataclass
@@ -277,10 +312,56 @@ class Executor:
                         f"{self.cluster.routing_epoch})"
                     )
 
+        if opt.at_position is not None:
+            return self._execute_at_position(index, idx, query, shards, opt)
+
         results = []
         for call in query.calls:
             results.append(self._execute_call(index, call, shards, opt))
 
+        return [
+            self._translate_result(index, idx, call, r)
+            for call, r in zip(query.calls, results)
+        ]
+
+    def _execute_at_position(self, index: str, idx, query, shards, opt):
+        """Point-in-time execution: the whole call tree runs against a
+        SHADOW executor whose holder materializes every fragment at the
+        requested CDC position (cdc/pit.py HistoricalHolder). The shadow
+        is a shallow copy with the device/cluster fast paths stubbed out
+        — materialized fragments live outside the engine's resident
+        stacks and generation tracking, so counts must take the host
+        map/reduce walk, and coalescing a frozen-past query with live
+        ones would poison the batcher's epoch-keyed groups. Per-shard
+        dispatch still uses the shared thread pool: every closure binds
+        the shadow, so pool threads see the historical holder too."""
+        import copy as _copy
+
+        from .cdc.pit import HistoricalHolder
+
+        cdc = getattr(self.holder, "cdc", None)
+        if cdc is None:
+            raise QueryError(
+                "at-position reads require change capture (cdc.enabled)")
+        if query.write_calls():
+            raise QueryError("at-position queries must be read-only")
+        if opt.remote or len(self.cluster.nodes) > 1:
+            # Positions are per-index but assigned per-node: another
+            # node's fragments carry DIFFERENT position stamps, so a
+            # fanned-out at-position read would mix timelines.
+            raise QueryError("at-position reads are node-local")
+        # Fast 410 gate before any materialization work.
+        cdc.check_position(index, opt.at_position)
+        shadow = _copy.copy(self)
+        shadow.holder = HistoricalHolder(
+            self.holder, cdc, index, opt.at_position)
+        shadow.collective = None
+        shadow.batcher = None
+        shadow.hints = None
+        shadow._engine = _NO_DEVICE_ENGINE
+        results = []
+        for call in query.calls:
+            results.append(shadow._execute_call(index, call, shards, opt))
         return [
             self._translate_result(index, idx, call, r)
             for call, r in zip(query.calls, results)

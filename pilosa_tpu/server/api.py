@@ -104,6 +104,7 @@ class API:
         deadline=None,
         traffic_class: Optional[str] = None,
         epoch: Optional[int] = None,
+        at_position: Optional[int] = None,
     ) -> List[Any]:
         """Execute PQL under the query scheduler's lifecycle: admit (429
         when the queue is full) -> wait (bounded by `deadline`) ->
@@ -119,6 +120,7 @@ class API:
             exclude_columns=exclude_columns,
             deadline=deadline,
             epoch=epoch,
+            at_position=at_position,
         )
         sched = getattr(self.server, "scheduler", None)
         if sched is None:
@@ -174,6 +176,56 @@ class API:
                     attrs.append({"id": col, "attrs": a})
             out["columnAttrs"] = attrs
         return out
+
+    # ------------------------------------------------------------------ cdc
+
+    @property
+    def cdc(self):
+        return getattr(self.server, "cdc", None)
+
+    def _require_cdc(self):
+        mgr = self.cdc
+        if mgr is None:
+            raise ApiError(
+                "change capture is disabled (set cdc.enabled = true)")
+        return mgr
+
+    def cdc_stream(self, index: str, from_pos: int,
+                   incarnation: Optional[str] = None,
+                   timeout: Optional[float] = None,
+                   max_bytes: int = 4 << 20):
+        """One chunk of the resumable change stream: raw framed op
+        records for positions > from_pos (cdc/log.py framing), the next
+        cursor, and the log incarnation. Raises CdcGoneError (410) when
+        the cursor fell behind retention or the index was recreated."""
+        return self._require_cdc().stream(
+            index, from_pos, inc=incarnation, timeout=timeout,
+            max_bytes=max_bytes)
+
+    def cdc_bootstrap(self, index: str) -> dict:
+        """Snapshot re-seed for a behind-retention consumer: compressed
+        fragment images + the position each was cut at."""
+        return self._require_cdc().bootstrap(index)
+
+    def cdc_standing_register(self, index: str, pql: str) -> dict:
+        mgr = self._require_cdc()
+        sq, created = mgr.standing.register(index, pql)
+        out = sq.to_dict()
+        out["created"] = created
+        return out
+
+    def cdc_standing_list(self) -> dict:
+        return {"queries": self._require_cdc().standing.list()}
+
+    def cdc_standing_poll(self, sid: str, after_version: int,
+                          timeout: Optional[float] = None) -> dict:
+        mgr = self._require_cdc()
+        if timeout is None:
+            timeout = mgr.config.poll_timeout
+        return mgr.standing.poll(sid, after_version, timeout)
+
+    def cdc_standing_delete(self, sid: str) -> None:
+        self._require_cdc().standing.delete(sid)
 
     # --------------------------------------------------------------- schema
 

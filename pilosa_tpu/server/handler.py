@@ -129,6 +129,12 @@ class Handler:
             Route("POST", r"/internal/index/(?P<index>[^/]+)/attr/diff", self.handle_index_attr_diff),
             Route("POST", r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/attr/diff", self.handle_field_attr_diff),
             Route("POST", r"/internal/fragment/hints", self.handle_post_hint_ops),
+            Route("GET", r"/cdc/stream", self.handle_cdc_stream),
+            Route("GET", r"/cdc/bootstrap", self.handle_cdc_bootstrap),
+            Route("POST", r"/cdc/standing", self.handle_cdc_standing_register),
+            Route("GET", r"/cdc/standing", self.handle_cdc_standing_list),
+            Route("GET", r"/cdc/standing/(?P<sid>[^/]+)/poll", self.handle_cdc_standing_poll),
+            Route("DELETE", r"/cdc/standing/(?P<sid>[^/]+)", self.handle_cdc_standing_delete),
             Route("GET", r"/debug/vars", self.handle_debug_vars),
             Route("GET", r"/debug/traces", self.handle_debug_traces),
             Route("GET", r"/metrics", self.handle_metrics),
@@ -204,6 +210,25 @@ class Handler:
                     return (503, "application/json",
                             json.dumps({"error": str(e)}).encode(),
                             {"Retry-After": "1"})
+                from ..errors import CdcGoneError
+
+                if isinstance(e, CdcGoneError):
+                    # Typed retention miss (docs/cdc.md): the cursor or
+                    # at-position fell behind the change log's fold line,
+                    # or the index was deleted+recreated (stale
+                    # incarnation). 410 GONE — retrying the same cursor
+                    # can never succeed; the body carries the retained
+                    # window + live incarnation so the consumer re-seeds
+                    # via /cdc/bootstrap instead of guessing.
+                    payload = {"error": str(e)}
+                    if e.first is not None:
+                        payload["first"] = e.first
+                    if e.last is not None:
+                        payload["last"] = e.last
+                    if e.incarnation is not None:
+                        payload["incarnation"] = e.incarnation
+                    return (410, "application/json",
+                            json.dumps(payload).encode())
                 from ..errors import ShardMovedError, StaleRoutingEpochError
 
                 if isinstance(e, (ShardMovedError, StaleRoutingEpochError)):
@@ -371,6 +396,18 @@ class Handler:
                 epoch = int(raw_epoch)
             except ValueError:
                 epoch = None
+        # Point-in-time read (docs/cdc.md): execute against the index as
+        # of this CDC position instead of live storage. Also accepted as
+        # ?atPosition= for clients that can't set headers.
+        at_position = None
+        raw_at = headers.get("x-pilosa-at-position") or \
+            query.get("atPosition", [None])[0]
+        if raw_at:
+            try:
+                at_position = int(raw_at)
+            except ValueError:
+                raise PilosaError(
+                    f"invalid at-position value: {raw_at!r}") from None
         remote = query.get("remote", ["false"])[0] == "true"
         column_attrs = query.get("columnAttrs", ["false"])[0] == "true"
         exclude_row_attrs = query.get("excludeRowAttrs", ["false"])[0] == "true"
@@ -423,13 +460,13 @@ class Handler:
             return self._post_query_traced(
                 index, pql, shards, remote, column_attrs, exclude_row_attrs,
                 exclude_columns, deadline, epoch, wants_proto, headers,
-                None, None)
+                None, None, at_position)
         token = _obs.activate(trace)
         try:
             return self._post_query_traced(
                 index, pql, shards, remote, column_attrs, exclude_row_attrs,
                 exclude_columns, deadline, epoch, wants_proto, headers,
-                recorder, trace)
+                recorder, trace, at_position)
         except BaseException:
             recorder.finish(trace, status="error")
             raise
@@ -439,7 +476,8 @@ class Handler:
 
     def _post_query_traced(self, index, pql, shards, remote, column_attrs,
                            exclude_row_attrs, exclude_columns, deadline,
-                           epoch, wants_proto, headers, recorder, trace):
+                           epoch, wants_proto, headers, recorder, trace,
+                           at_position=None):
         if wants_proto:
             from . import proto
             from ..errors import PilosaError
@@ -450,6 +488,7 @@ class Handler:
                     exclude_row_attrs=exclude_row_attrs,
                     exclude_columns=exclude_columns,
                     deadline=deadline,
+                    at_position=at_position,
                 )
             except PilosaError as e:
                 from ..sched import DeadlineExceededError, QueueFullError
@@ -465,7 +504,8 @@ class Handler:
 
         if remote:
             results = self.api.query(index, pql, shards=shards, remote=True,
-                                     deadline=deadline, epoch=epoch)
+                                     deadline=deadline, epoch=epoch,
+                                     at_position=at_position)
             from . import wire
 
             extra = {}
@@ -492,7 +532,7 @@ class Handler:
         return self.api.query_response(
             index, pql, shards=shards, column_attrs=column_attrs,
             exclude_row_attrs=exclude_row_attrs, exclude_columns=exclude_columns,
-            deadline=deadline,
+            deadline=deadline, at_position=at_position,
         )
 
     def _column_attr_sets(self, index, results):
@@ -589,6 +629,69 @@ class Handler:
             query["index"][0], query["field"][0], query["view"][0],
             int(query["shard"][0]), body,
         )
+        return {}
+
+    # ------------------------------------------------------------------ cdc
+
+    def handle_cdc_stream(self, query, **kw):
+        """GET /cdc/stream?index=X&from=P — one long-poll chunk of the
+        change stream: raw framed op records (cdc/log.py framing — the
+        response bytes are byte-identical to the on-disk log slice) for
+        positions > P. X-Pilosa-Cdc-Next is the cursor for the next
+        request; X-Pilosa-Cdc-Incarnation pins the index generation
+        (pass it back as &incarnation= to get a 410 instead of silent
+        aliasing after a delete+recreate). Empty body = timeout with no
+        new records (re-poll from the same cursor)."""
+        if "index" not in query:
+            raise PilosaError("index parameter required")
+        index = query["index"][0]
+        try:
+            from_pos = int(query.get("from", ["0"])[0])
+            timeout = (float(query["timeout"][0]) if "timeout" in query
+                       else None)
+            max_bytes = int(query.get("max-bytes", [str(4 << 20)])[0])
+        except ValueError as e:
+            raise PilosaError(f"invalid /cdc/stream parameter: {e}") from None
+        inc = query.get("incarnation", [None])[0]
+        data, nxt, incarnation = self.api.cdc_stream(
+            index, from_pos, incarnation=inc, timeout=timeout,
+            max_bytes=max_bytes)
+        return (200, "application/octet-stream", data,
+                {"X-Pilosa-Cdc-Next": str(nxt),
+                 "X-Pilosa-Cdc-Incarnation": incarnation})
+
+    def handle_cdc_bootstrap(self, query, **kw):
+        """GET /cdc/bootstrap?index=X — snapshot re-seed for a consumer
+        whose cursor 410'd: zlib-compressed base64 roaring images per
+        fragment plus the position each was cut at. Resume the stream
+        from the returned `from`; overlap replays idempotently."""
+        if "index" not in query:
+            raise PilosaError("index parameter required")
+        return self.api.cdc_bootstrap(query["index"][0])
+
+    def handle_cdc_standing_register(self, body, **kw):
+        req = _json_body(body)
+        index = req.get("index", "")
+        pql = req.get("query", "")
+        if not index or not pql:
+            raise PilosaError("index and query fields required")
+        return self.api.cdc_standing_register(index, pql)
+
+    def handle_cdc_standing_list(self, **kw):
+        return self.api.cdc_standing_list()
+
+    def handle_cdc_standing_poll(self, sid, query, **kw):
+        try:
+            after = int(query.get("version", ["0"])[0])
+            timeout = (float(query["timeout"][0]) if "timeout" in query
+                       else None)
+        except ValueError as e:
+            raise PilosaError(
+                f"invalid /cdc/standing poll parameter: {e}") from None
+        return self.api.cdc_standing_poll(sid, after, timeout)
+
+    def handle_cdc_standing_delete(self, sid, **kw):
+        self.api.cdc_standing_delete(sid)
         return {}
 
     def handle_post_block_data(self, query, body, **kw):
@@ -804,6 +907,13 @@ class Handler:
         hints = getattr(self.api.server, "hints", None)
         if hints is not None:
             out["replication"] = hints.snapshot()
+        # CDC health (docs/cdc.md): per-index position window + retention
+        # counters, PIT cache hit rate, standing-query eval/push/stale
+        # totals — the on-call question for a lagging consumer is "did my
+        # cursor fall behind the fold line, and how fast is it moving".
+        cdc = getattr(self.api.server, "cdc", None)
+        if cdc is not None:
+            out["cdc"] = cdc.debug_vars()
         # Per-query tracing health (docs/observability.md): sampler
         # counters, ring depth, slow-query count — the aggregate next to
         # the per-trace detail /debug/traces serves.

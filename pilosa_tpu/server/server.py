@@ -70,6 +70,7 @@ class Server:
         collective_config=None,
         tier_config=None,
         obs_config=None,
+        cdc_config=None,
         join_addr: Optional[str] = None,
         allowed_origins: Optional[List[str]] = None,
         tls_certificate: Optional[str] = None,
@@ -162,6 +163,23 @@ class Server:
         # complete would bump the routing epoch a second time.
         self._rebalance_seen: dict = {}
 
+        # CDC change capture (cdc/, docs/cdc.md): built BEFORE the Holder
+        # so the manager threads down Holder -> ... -> Fragment like the
+        # snapshotter; the manager's holder/executor backrefs are wired
+        # right after those exist. None = capture off (the default).
+        from ..cdc import CdcConfig
+
+        self.cdc_config = (cdc_config or CdcConfig()).validate()
+        self.cdc = None
+        if self.cdc_config.enabled:
+            from ..cdc.manager import CdcManager
+            from ..storage import StorageConfig
+
+            self.cdc = CdcManager(
+                self.cdc_config,
+                os.path.join(data_dir, "cdc") if data_dir else None,
+                storage_config or StorageConfig(),
+            )
         self.holder = Holder(
             os.path.join(data_dir, "indexes") if data_dir else None,
             stats=self.stats,
@@ -169,7 +187,10 @@ class Server:
             storage_config=storage_config,
             delta_journal_ops=(
                 engine_config.delta_journal_ops if engine_config else None),
+            cdc=self.cdc,
         )
+        if self.cdc is not None:
+            self.cdc.holder = self.holder
         self.translate_store = TranslateStore(
             os.path.join(data_dir, "keys") if data_dir else None,
             read_only=primary_translate_store_url is not None,
@@ -219,6 +240,9 @@ class Server:
         # Writes racing a live-rebalance cutover re-route/wait up to this
         # long for the commit broadcast before failing clean.
         self.executor.cutover_wait = self.rebalance_config.cutover_pause_max
+        if self.cdc is not None:
+            # Standing-query evaluation runs real read queries.
+            self.cdc.executor = self.executor
         # Durable write replication (cluster/hints.py, docs/durability.md
         # "Write-path consistency"): per-peer hint logs under the data
         # dir catch writes a replica missed (breaker open / transport
@@ -438,6 +462,12 @@ class Server:
                         self.replication_config.deliver_interval)
         if self.cache_flush_interval > 0:
             self._spawn(self._monitor_cache_flush, self.cache_flush_interval)
+        if self.cdc is not None and self.cdc_config.standing_interval > 0:
+            # The staleness sweep: cheap (an epoch compare per
+            # registration) when nothing changed, so a short cadence is
+            # safe. 0 = tests drive evaluate_once() by hand.
+            self._spawn(self._monitor_standing_queries,
+                        self.cdc_config.standing_interval)
         if self.metric_poll_interval > 0:
             self._spawn(self._monitor_runtime, self.metric_poll_interval)
         if self.primary_translate_store_url:
@@ -678,6 +708,11 @@ class Server:
         self.executor.close()
         self._probe_client.close()
         self.hints.close()
+        if self.cdc is not None:
+            # After the holder stops accepting writes would be ideal, but
+            # append() on a closed log is a no-op return, so closing here
+            # (before holder.close flushes fragments) is safe either way.
+            self.cdc.close()
         self.holder.close()
         self.translate_store.close()
         self.opened = False
@@ -726,6 +761,12 @@ class Server:
 
     def _monitor_cache_flush(self) -> None:
         self.holder.flush_caches()
+
+    def _monitor_standing_queries(self) -> None:
+        """Standing-query staleness sweep (cdc/standing.py): re-evaluate
+        registrations whose index write epoch moved, push only changed
+        results to their long-poll waiters."""
+        self.cdc.standing.evaluate_once()
 
     def _monitor_hints(self) -> None:
         """Hinted-handoff delivery sweep (cluster/hints.py): replay

@@ -96,7 +96,7 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     assert not detail.get("partial"), detail.get("partial")
     assert parsed["value"] > 0
     stanzas = _registered_stanzas()
-    assert len(stanzas) >= 19  # the registry itself didn't shrink
+    assert len(stanzas) >= 20  # the registry itself didn't shrink
     for name in stanzas:
         stanza = detail.get(name.lower())
         assert isinstance(stanza, dict), f"stanza {name} missing: {stanza}"
@@ -135,6 +135,24 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
         lambda r: r["drained"] and r["hint_drain_s"] < 30, tmp_path)
     assert repl["drained"], repl
     assert repl["hint_drain_s"] < 30, repl
+    # The CDC stanza is the change-data-capture acceptance metric
+    # (docs/cdc.md): the tailing consumer must see a dense, loss-free
+    # position stream whose replay is byte-identical to the live
+    # fragment; at-position reads must equal the answers frozen at each
+    # checkpoint; and the standing Count must re-push within ONE
+    # evaluator sweep of a change — and never for an unrelated write.
+    # All correctness gates — never retried. The delivery-lag timing
+    # gate gets the standard one-shot isolation rerun.
+    cdc = detail["cdc"]
+    assert cdc["tail"]["dense"], cdc
+    assert cdc["tail"]["bit_exact"], cdc
+    assert cdc["pit"]["bit_exact"], cdc
+    assert cdc["standing"]["pushed_on_change"], cdc
+    assert not cdc["standing"]["pushed_on_unrelated"], cdc
+    assert cdc["cdc_ok"], cdc
+    cdc = _retry_ratio_gate(
+        "CDC", cdc, lambda c: c["tail"]["lag_p99_ms"] < 250, tmp_path)
+    assert cdc["tail"]["lag_p99_ms"] < 250, cdc
     # The DEGRADE stanza is the device-fault acceptance metric: with
     # every engine dispatch failing, the degraded phase must serve with
     # ZERO query errors and bit-exact results (the host ladder), injected

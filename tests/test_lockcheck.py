@@ -403,14 +403,16 @@ def test_instrumented_smoke_chaos_tier_rebalance(tmp_path):
     """Tier-1 enforcement: the concurrency-heavy test files (chaos fault
     injection, tier demote/promote/prefetch workers, live rebalance
     migration streams, the device-fault ladder's host-execution +
-    breaker paths, and the hinted-handoff append/deliver machinery under
-    quorum-write replica flaps) run fully instrumented and must produce
-    zero lock-order cycles and zero blocking-under-lock findings — the
-    runtime half of the acceptance bar in docs/static-analysis.md."""
+    breaker paths, the hinted-handoff append/deliver machinery under
+    quorum-write replica flaps, and the CDC change-log append/compact/
+    long-poll paths nested inside the fragment mutex) run fully
+    instrumented and must produce zero lock-order cycles and zero
+    blocking-under-lock findings — the runtime half of the acceptance
+    bar in docs/static-analysis.md."""
     payload = _run_instrumented(
         ["tests/test_chaos.py", "tests/test_tier.py",
          "tests/test_rebalance.py", "tests/test_device_faults.py",
-         "tests/test_replication.py"],
+         "tests/test_replication.py", "tests/test_cdc.py"],
         tmp_path / "lockcheck.json", timeout=600,
         # Seeded schedule perturbation (tiny randomized yields at every
         # lock-acquire boundary): the chaos smokes explore interleavings

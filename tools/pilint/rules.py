@@ -210,6 +210,7 @@ JAX_FREE_ZONES = (
     "pilosa_tpu/sched/",
     "pilosa_tpu/obs/",
     "pilosa_tpu/plan/",
+    "pilosa_tpu/cdc/",
 )
 
 
@@ -1467,6 +1468,7 @@ R11_SECTIONS: Dict[str, Tuple[str, str, str, str]] = {
     "ReplicationConfig": ("replication", "replication", "REPLICATION",
                           "docs/durability.md"),
     "ObsConfig": ("obs", "obs", "OBS", "docs/observability.md"),
+    "CdcConfig": ("cdc", "cdc", "CDC", "docs/cdc.md"),
 }
 CONFIG_FILE = "pilosa_tpu/config.py"
 CLI_FILE = "pilosa_tpu/cli.py"
