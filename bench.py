@@ -3134,6 +3134,237 @@ def bench_multichip():
         f"multichip child produced no result line: {r.stdout[-500:]}")
 
 
+# ------------------------------------------------------------- GEO stanza
+
+
+def bench_geo():
+    """Geo replication (docs/geo-replication.md): two clusters on one
+    box — the leader as a SEPARATE PROCESS (SIGKILL-able), the follower
+    in-process tailing its CDC feed. Phases: sustained ingest on the
+    leader with replication-lag sampling (p50/p99 from leader-stamped
+    times, never follower wall clocks) and bounded-staleness serving ->
+    catch-up -> kill -9 the leader -> promote the follower (fenced
+    epoch bump) -> keep writing on the new leader -> restart the old
+    leader (the fence demotes it and it re-tails) -> verify ZERO lost
+    acked writes on BOTH clusters and byte-identical fragments."""
+    import io
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+    import textwrap
+
+    from pilosa_tpu.cdc import CdcConfig
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.errors import PilosaError, StaleReadError
+    from pilosa_tpu.geo import GeoConfig
+    from pilosa_tpu.server.client import ClientError, InternalClient
+    from pilosa_tpu.server.server import Server
+
+    n_shards, per_phase = (2, 20) if SMOKE else (2, 120)
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmp = tempfile.mkdtemp(prefix="bench-geo-")
+    ports = [free_port(), free_port()]
+    hosts = [f"localhost:{p}" for p in ports]
+    out = {"shards": n_shards, "writes_per_phase": per_phase}
+    follower = None
+    child = None
+
+    child_src = textwrap.dedent("""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from pilosa_tpu.cdc import CdcConfig
+        from pilosa_tpu.geo import GeoConfig
+        from pilosa_tpu.server.server import Server
+        import time
+        s = Server(
+            data_dir=sys.argv[1], port=int(sys.argv[2]),
+            cache_flush_interval=0, anti_entropy_interval=0,
+            member_monitor_interval=0, executor_workers=0,
+            cdc_config=CdcConfig(enabled=True),
+            geo_config=GeoConfig(role="leader"),
+        )
+        s.open()
+        print("ready", flush=True)
+        while True:
+            time.sleep(3600)
+    """)
+
+    def spawn_child():
+        p = subprocess.Popen(
+            [sys.executable, "-c", child_src,
+             os.path.join(tmp, "leader"), str(ports[0])],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        line = p.stdout.readline()
+        if "ready" not in line:
+            err = p.stderr.read()
+            raise RuntimeError(f"geo leader failed to open: {err[-400:]}")
+        return p
+
+    def col_of(i):
+        return (i % n_shards) * SHARD_WIDTH + 10 + i
+
+    try:
+        child = spawn_child()
+        follower = Server(
+            data_dir=os.path.join(tmp, "follower"), port=ports[1],
+            cache_flush_interval=0, anti_entropy_interval=0,
+            member_monitor_interval=0, executor_workers=0,
+            cdc_config=CdcConfig(enabled=True),
+            geo_config=GeoConfig(role="follower", leader=hosts[0],
+                                 backoff=0.1),
+        )
+        follower.open()
+        client = InternalClient(timeout=10.0)
+        client.create_index(hosts[0], "geo")
+        client.create_field(hosts[0], "geo", "f")
+        # The follower learns the index from its next schema sync; gate
+        # phase 1 on that so lag samples measure replication, not the
+        # sync cadence.
+        deadline = time.perf_counter() + 30.0
+        while (time.perf_counter() < deadline
+               and follower.holder.index("geo") is None):
+            time.sleep(0.05)
+        assert follower.holder.index("geo") is not None
+
+        # Phase 1: sustained ingest on the leader; sample follower lag
+        # after every acked write; serve bounded-staleness reads locally.
+        acked = []
+        lags = []
+        served = refused = 0
+        t0 = time.perf_counter()
+        for i in range(per_phase):
+            client.query(hosts[0], "geo", f"Set({col_of(i)}, f=7)")
+            acked.append(col_of(i))
+            lag = follower.geo.lag()
+            if lag != float("inf"):
+                lags.append(lag)
+            try:
+                follower.api.query("geo", "Count(Row(f=7))",
+                                   max_staleness=30.0)
+                served += 1
+            except StaleReadError:
+                refused += 1
+        out["ingest_qps"] = round(per_phase / (time.perf_counter() - t0), 1)
+        lags.sort()
+        pick = lambda q: round(lags[min(len(lags) - 1, int(len(lags) * q))] * 1e3, 2)  # noqa: E731
+        out["lag_samples"] = len(lags)
+        out["lag_p50_ms"] = pick(0.50) if lags else None
+        out["lag_p99_ms"] = pick(0.99) if lags else None
+        out["staleness"] = {"served": served, "refused": refused}
+
+        # Catch-up, then prove the 409 arm: a zero bound can never be
+        # satisfied (lag includes time since last leader contact).
+        deadline = time.perf_counter() + 30.0
+        while (time.perf_counter() < deadline
+               and follower.api.query("geo", "Count(Row(f=7))")[0]
+               != len(acked)):
+            time.sleep(0.05)
+        out["caught_up"] = (
+            follower.api.query("geo", "Count(Row(f=7))")[0] == len(acked))
+        try:
+            follower.api.query("geo", "Count(Row(f=7))", max_staleness=0.0)
+            out["stale_409_seen"] = False
+        except StaleReadError:
+            out["stale_409_seen"] = True
+
+        # Leader loss: kill -9, promote the follower (epoch fence), keep
+        # ingesting on the new leader.
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+        st = follower.geo.promote()
+        out["promoted_epoch"] = st["epoch"]
+        for i in range(per_phase, 2 * per_phase):
+            follower.api.query("geo", f"Set({col_of(i)}, f=7)")
+            acked.append(col_of(i))
+
+        # Old leader rejoins: the pending fence demotes it (it adopts the
+        # new epoch and re-tails the promoted follower from scratch).
+        child = spawn_child()
+        t0 = time.perf_counter()
+        deadline = t0 + 60.0
+        demoted = False
+        while time.perf_counter() < deadline and not demoted:
+            try:
+                demoted = client.geo_status(hosts[0])["role"] == "follower"
+            except (ClientError, OSError):
+                pass
+            if not demoted:
+                time.sleep(0.1)
+        out["fence_s"] = round(time.perf_counter() - t0, 3)
+        out["demoted"] = demoted
+        t0 = time.perf_counter()
+        deadline = t0 + 60.0
+        converged = False
+        while time.perf_counter() < deadline and not converged:
+            try:
+                got = client.query(hosts[0], "geo",
+                                   "Count(Row(f=7))")["results"][0]
+                converged = got == len(acked)
+            except (ClientError, PilosaError, OSError):
+                pass
+            if not converged:
+                time.sleep(0.1)
+        out["converge_s"] = round(time.perf_counter() - t0, 3)
+        out["converged"] = converged
+
+        # Zero lost acked writes on BOTH clusters, byte-identical
+        # fragments: the set compare proves the promoted leader, the
+        # byte compare extends the proof to the re-tailed old leader.
+        lost = 0
+        byte_identical = True
+        for shard in range(n_shards):
+            frag = follower.holder.fragment("geo", "f", "standard", shard)
+            if frag is None:
+                lost += sum(1 for c in acked if c // SHARD_WIDTH == shard)
+                byte_identical = False
+                continue
+            b0 = io.BytesIO()
+            frag.write_to(b0)
+            try:
+                remote = client.retrieve_shard_from_uri(
+                    hosts[0], "geo", "f", "standard", shard)
+            except (ClientError, PilosaError):
+                byte_identical = False
+                continue
+            if remote != b0.getvalue():
+                byte_identical = False
+            want = {7 * SHARD_WIDTH + (c % SHARD_WIDTH)
+                    for c in acked if c // SHARD_WIDTH == shard}
+            have = {int(p) for p in frag.storage.slice()}
+            lost += len(want - have)
+        out["lost_acked_writes"] = lost
+        out["byte_identical"] = byte_identical
+        out["geo_ok"] = bool(
+            out["caught_up"] and out["stale_409_seen"] and demoted
+            and converged and lost == 0 and byte_identical)
+    finally:
+        if follower is not None:
+            try:
+                follower.close()
+            except Exception:
+                pass
+        if child is not None:
+            try:
+                child.kill()
+                child.wait(timeout=10)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # Every optional stanza, in run order. THE registry: main() runs exactly
 # these, the FINAL JSON line carries a key per entry (lowercased), and
 # tests/test_bench_smoke.py asserts every name is present — a stanza
@@ -3160,6 +3391,7 @@ STANZAS = (
     ("MULTICHIP", bench_multichip),
     ("TOPN_BSI", bench_topn_bsi),
     ("TIME_RANGE", bench_time_range),
+    ("GEO", bench_geo),
 )
 
 

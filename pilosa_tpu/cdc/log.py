@@ -5,8 +5,13 @@ One append-only file per index under `<data-dir>/cdc/<index>/log`
 adapted to CDC:
 
   <I body_len> <I crc32(body)> body
-  body := <Q position> <Q shard> <H len(index)> <H len(field)>
+  body := <Q position> <Q shard> <d stamp> <H len(index)> <H len(field)>
           <H len(view)> index field view ops
+
+`stamp` is the LEADER's wall clock (time.time()) at append. Geo
+followers (pilosa_tpu/geo/) derive replication lag from it by comparing
+leader stamps against the leader-reported head time — never against a
+follower clock, so cross-cluster clock skew cancels out of the lag.
 
 `ops` is a run of storage/bitmap.py WAL records (point + OP_BULK) —
 byte-identical to what the fragment's own WAL appended for the same
@@ -51,7 +56,7 @@ from .. import failpoints
 from ..errors import CdcGoneError
 
 _HEAD = struct.Struct("<II")
-_BODY = struct.Struct("<QQHHH")
+_BODY = struct.Struct("<QQdHHH")
 
 # Torn-tail scanning needs an upper bound to reject absurd lengths from
 # bit rot without reading the whole remainder as one "record".
@@ -60,9 +65,10 @@ _MAX_RECORD = 256 << 20
 
 class CdcRecord:
     __slots__ = ("position", "index", "field", "view", "shard", "ops",
-                 "size")
+                 "size", "stamp")
 
-    def __init__(self, position, index, field, view, shard, ops, size=0):
+    def __init__(self, position, index, field, view, shard, ops, size=0,
+                 stamp=0.0):
         self.position = position
         self.index = index
         self.field = field
@@ -70,13 +76,15 @@ class CdcRecord:
         self.shard = shard
         self.ops = ops   # WAL op records (storage/bitmap decode_op_records)
         self.size = size  # on-disk footprint incl. framing
+        self.stamp = stamp  # leader wall clock at append (lag derivation)
 
 
 def encode_cdc_record(rec: CdcRecord) -> bytes:
     i = rec.index.encode()
     f = rec.field.encode()
     v = rec.view.encode()
-    body = _BODY.pack(rec.position, rec.shard, len(i), len(f), len(v)) \
+    body = _BODY.pack(rec.position, rec.shard, rec.stamp,
+                      len(i), len(f), len(v)) \
         + i + f + v + rec.ops
     return _HEAD.pack(len(body), zlib.crc32(body)) + body
 
@@ -95,14 +103,14 @@ def decode_cdc_records(data: bytes, offset: int = 0):
         body = data[offset + _HEAD.size:end]
         if zlib.crc32(body) != crc:
             return
-        position, shard, li, lf, lv = _BODY.unpack_from(body, 0)
+        position, shard, stamp, li, lf, lv = _BODY.unpack_from(body, 0)
         p = _BODY.size
         index = body[p:p + li].decode()
         field = body[p + li:p + li + lf].decode()
         view = body[p + li + lf:p + li + lf + lv].decode()
         ops = bytes(body[p + li + lf + lv:])
         yield CdcRecord(position, index, field, view, shard, ops,
-                        size=end - offset), end
+                        size=end - offset, stamp=stamp), end
         offset = end
 
 
@@ -131,6 +139,12 @@ class CdcLog:
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.closed = False
+        # Server shutdown signal: parked long-poll readers wake and return
+        # an EMPTY chunk (a routine re-poll answer) instead of holding
+        # their handler threads until the poll timeout — and instead of
+        # the closed->410 path, which means "this index is GONE" and would
+        # make a live consumer discard a perfectly good cursor.
+        self.interrupted = False
         self.last_pos = 0   # newest assigned position (0 = none yet)
         self.base_pos = 0   # highest position folded into base images
         self.size = 0       # retained log bytes
@@ -207,6 +221,13 @@ class CdcLog:
         # pilint: allow-blocking(atomic meta install under the log lock; tiny file, same tmp+replace contract as the fragment snapshot rename)
         os.replace(tmp, self._meta_path)
 
+    def interrupt(self) -> None:
+        """Unpark long-poll waiters without killing the log (server
+        shutdown, NOT index drop — drop keeps closed->410 semantics)."""
+        with self.cond:
+            self.interrupted = True
+            self.cond.notify_all()
+
     def close(self) -> None:
         with self.cond:
             self.closed = True
@@ -232,7 +253,8 @@ class CdcLog:
                 return 0
             pos = self.last_pos + 1
             frame = encode_cdc_record(
-                CdcRecord(pos, self.index, field, view, shard, ops))
+                CdcRecord(pos, self.index, field, view, shard, ops,
+                          stamp=time.time()))
             try:
                 failpoints.fire("cdc-append")
                 if self._fh is not None:
@@ -479,12 +501,19 @@ class CdcLog:
         deadline = time.monotonic() + max(0.0, timeout)
         with self.cond:
             self.check_cursor_locked(from_pos, inc)
-            while self.last_pos <= from_pos and not self.closed:
+            while self.last_pos <= from_pos and not self.closed \
+                    and not self.interrupted:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return b"", from_pos
                 # pilint: allow-blocking(long-poll wait point: releases the log lock while parked; appends wake it)
                 self.cond.wait(remaining)
+            if self.interrupted and self.last_pos <= from_pos:
+                # Server shutdown unparked us with nothing new: answer an
+                # empty poll (the consumer re-polls and then sees the
+                # socket die), NOT the closed->410 below — 410 means "the
+                # INDEX is gone, re-bootstrap", which a restart isn't.
+                return b"", from_pos
             if self.closed:
                 raise CdcGoneError(
                     f"index {self.index!r} dropped mid-stream",

@@ -15,6 +15,7 @@ import base64
 import os
 import shutil
 import threading
+import time
 import zlib
 from typing import Dict, Optional
 
@@ -105,6 +106,17 @@ class CdcManager:
         if d and os.path.isdir(d):
             shutil.rmtree(d, ignore_errors=True)
 
+    def interrupt(self) -> None:
+        """Unpark every log's long-poll waiters for server shutdown.
+        Called by Server.close() BEFORE the HTTP listener shuts down, so
+        a handler thread blocked in a /cdc/stream wait returns promptly
+        (empty chunk) instead of pinning shutdown until its poll timeout.
+        The logs stay open — drop_index keeps its closed->410 path."""
+        with self._mu:
+            logs = list(self._logs.values())
+        for log in logs:
+            log.interrupt()
+
     def close(self) -> None:
         self.standing.close()
         with self._mu:
@@ -129,6 +141,17 @@ class CdcManager:
                                  timeout=timeout)
             failpoints.fire("cdc-deliver")
             return data, nxt, log.incarnation
+
+    def head(self, index: str):
+        """(head_position, leader_now) for the stream response's lag
+        headers (X-Pilosa-Cdc-Head-Pos/-Time): the newest assigned
+        position and THIS node's wall clock, read together so a geo
+        follower can anchor 'how far behind is my applied stamp' against
+        a single leader-side observation — leader stamps compared to a
+        leader clock, never to the follower's."""
+        log = self.require_log(index)
+        with log.lock:
+            return log.last_pos, time.time()
 
     def bootstrap(self, index: str) -> dict:
         """Snapshot re-seed for a consumer whose cursor fell behind
@@ -170,6 +193,10 @@ class CdcManager:
             "incarnation": log.incarnation,
             "from": min((f["position"] for f in frags),
                         default=log.last_pos),
+            # Leader wall clock at the cut: the consumer's applied-stamp
+            # baseline after installing the images (geo lag needs a
+            # leader-side time even before the first streamed record).
+            "now": time.time(),
             "fragments": frags,
         }
 

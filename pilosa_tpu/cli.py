@@ -204,6 +204,28 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--cdc-pit-cache", dest="cdc_pit_cache", type=int,
                    help="materialized historical fragments kept in the "
                         "point-in-time LRU")
+    p.add_argument("--geo-role", dest="geo_role",
+                   choices=["none", "leader", "follower"],
+                   help="geo replication role: a follower tails the "
+                        "leader's CDC streams, refuses writes, and serves "
+                        "bounded-staleness reads (docs/geo-replication.md)")
+    p.add_argument("--geo-leader", dest="geo_leader", metavar="HOST:PORT",
+                   help="leader cluster URL a geo follower tails "
+                        "(required with --geo-role follower)")
+    p.add_argument("--geo-backoff", dest="geo_backoff", type=float,
+                   help="initial per-link tail breaker backoff in seconds "
+                        "(doubles per consecutive failed leader contact)")
+    p.add_argument("--geo-backoff-max", dest="geo_backoff_max", type=float,
+                   help="tail breaker backoff ceiling in seconds")
+    p.add_argument("--geo-probe-promote", dest="geo_probe_promote", type=int,
+                   metavar="{0,1}",
+                   help="1 lets a follower promote itself (bumping the "
+                        "fencing geo epoch) after geo-probe-failures "
+                        "consecutive failed leader contacts")
+    p.add_argument("--geo-probe-failures", dest="geo_probe_failures",
+                   type=int,
+                   help="consecutive failed leader contacts before a "
+                        "probe-driven promotion fires")
     p.add_argument("--sched-max-queue", dest="sched_max_queue", type=int,
                    help="bounded admission queue; full requests get 429")
     p.add_argument("--sched-interactive-concurrency",

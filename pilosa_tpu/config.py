@@ -117,6 +117,11 @@ from .obs import ObsConfig  # noqa: E402
 # (pilosa_tpu/cdc/, jax-free). See docs/cdc.md.
 from .cdc import CdcConfig  # noqa: E402
 
+# And for [geo]: the geo-replication knobs (cluster role, leader URL,
+# tail breaker backoff, probe-driven promotion) live with the geo
+# subsystem (pilosa_tpu/geo/, jax-free). See docs/geo-replication.md.
+from .geo import GeoConfig  # noqa: E402
+
 
 @dataclass
 class MetricConfig:
@@ -165,6 +170,7 @@ class Config:
     replication: ReplicationConfig = field(default_factory=ReplicationConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     cdc: CdcConfig = field(default_factory=CdcConfig)
+    geo: GeoConfig = field(default_factory=GeoConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     translation: TranslationConfig = field(default_factory=TranslationConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
@@ -285,6 +291,15 @@ class Config:
         self.cdc.standing_interval = cd.get(
             "standing-interval", self.cdc.standing_interval)
         self.cdc.pit_cache = cd.get("pit-cache", self.cdc.pit_cache)
+        ge = d.get("geo", {})
+        self.geo.role = ge.get("role", self.geo.role)
+        self.geo.leader = ge.get("leader", self.geo.leader)
+        self.geo.backoff = ge.get("backoff", self.geo.backoff)
+        self.geo.backoff_max = ge.get("backoff-max", self.geo.backoff_max)
+        self.geo.probe_promote = ge.get(
+            "probe-promote", self.geo.probe_promote)
+        self.geo.probe_failures = ge.get(
+            "probe-failures", self.geo.probe_failures)
         s = d.get("scheduler", {})
         self.scheduler.max_queue = s.get("max-queue", self.scheduler.max_queue)
         self.scheduler.interactive_concurrency = s.get(
@@ -484,6 +499,17 @@ class Config:
             if v is not None:
                 setattr(self.cdc, attr, v)
         for attr, name, cast in [
+            ("role", "GEO_ROLE", str),
+            ("leader", "GEO_LEADER", str),
+            ("backoff", "GEO_BACKOFF", float),
+            ("backoff_max", "GEO_BACKOFF_MAX", float),
+            ("probe_promote", "GEO_PROBE_PROMOTE", bool),
+            ("probe_failures", "GEO_PROBE_FAILURES", int),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.geo, attr, v)
+        for attr, name, cast in [
             ("max_queue", "SCHED_MAX_QUEUE", int),
             ("interactive_concurrency", "SCHED_INTERACTIVE_CONCURRENCY", int),
             ("batch_concurrency", "SCHED_BATCH_CONCURRENCY", int),
@@ -644,6 +670,12 @@ class Config:
             "cdc_poll_timeout": ("cdc", "poll_timeout"),
             "cdc_standing_interval": ("cdc", "standing_interval"),
             "cdc_pit_cache": ("cdc", "pit_cache"),
+            "geo_role": ("geo", "role"),
+            "geo_leader": ("geo", "leader"),
+            "geo_backoff": ("geo", "backoff"),
+            "geo_backoff_max": ("geo", "backoff_max"),
+            "geo_probe_promote": ("geo", "probe_promote"),
+            "geo_probe_failures": ("geo", "probe_failures"),
             "sched_max_queue": ("scheduler", "max_queue"),
             "sched_interactive_concurrency": ("scheduler", "interactive_concurrency"),
             "sched_batch_concurrency": ("scheduler", "batch_concurrency"),
@@ -781,6 +813,14 @@ class Config:
             f"standing-interval = {self.cdc.standing_interval}",
             f"pit-cache = {self.cdc.pit_cache}",
             "",
+            "[geo]",
+            f"role = {fmt(self.geo.role)}",
+            f"leader = {fmt(self.geo.leader)}",
+            f"backoff = {self.geo.backoff}",
+            f"backoff-max = {self.geo.backoff_max}",
+            f"probe-promote = {fmt(self.geo.probe_promote)}",
+            f"probe-failures = {self.geo.probe_failures}",
+            "",
             "[scheduler]",
             f"max-queue = {self.scheduler.max_queue}",
             f"interactive-concurrency = {self.scheduler.interactive_concurrency}",
@@ -893,6 +933,7 @@ class Config:
             rebalance_config=self.rebalance.validate(),
             obs_config=self.obs.validate(),
             cdc_config=self.cdc.validate(),
+            geo_config=self.geo.validate(),
         )
         kw.update(overrides)
         return Server(**kw)

@@ -249,6 +249,27 @@ class DiagnosticsCollector:
             info["rebalanceEpoch"] = self.server.cluster.routing_epoch
             info["rebalanceActive"] = (
                 self.server.cluster.next_nodes is not None)
+        # Geo-replication shape: which role the node plays, what fencing
+        # epoch it serves under, and — on followers — how far behind the
+        # leader the tail is plus how much work it has replayed. A leader
+        # that suddenly reports refused writes is the fleet-level signal
+        # of a fenced split-brain survivor (per-link detail stays in
+        # /debug/vars under the `geo` group).
+        geo = getattr(self.server, "geo", None)
+        if geo is not None:
+            snap = geo.debug_vars()
+            info["geoRole"] = snap.get("role", "none")
+            info["geoEpoch"] = snap.get("epoch", 0)
+            info["geoPromotions"] = snap.get("promotions", 0)
+            info["geoPromoteAborts"] = snap.get("promote_aborts", 0)
+            info["geoDemotions"] = snap.get("demotions", 0)
+            info["geoWritesRefused"] = snap.get("writes_refused", 0)
+            tail = snap.get("tail", {})
+            if snap.get("role") == "follower":
+                info["geoLagSeconds"] = tail.get("lag")
+                info["geoRecordsApplied"] = tail.get("records_applied", 0)
+                info["geoBootstraps"] = tail.get("bootstraps", 0)
+                info["geoLinkFailures"] = tail.get("link_failures", 0)
         info.update(system_info())
         info.update(self._extra)
         return info

@@ -143,6 +143,40 @@ class CdcGoneError(PilosaError):
         self.incarnation = incarnation  # the log's current incarnation
 
 
+class StaleReadError(PilosaError):
+    """A read carried `X-Pilosa-Max-Staleness: <s>` to a geo follower
+    whose replication lag exceeds the bound (pilosa_tpu/geo/,
+    docs/geo-replication.md). Maps to HTTP 409 with the CURRENT lag in
+    the payload so the client can decide: relax the bound and re-read
+    here, or fail over to the leader. On a non-geo (single-cluster) node
+    the header is a documented no-op — local reads are never stale."""
+
+    message = "read staleness bound exceeded"
+
+    def __init__(self, *args, lag=None, bound=None, position=None):
+        super().__init__(*args)
+        self.lag = lag            # current replication lag, seconds
+        self.bound = bound        # the request's max-staleness bound
+        self.position = position  # last applied CDC position, when known
+
+
+class StaleGeoEpochError(PilosaError):
+    """A write (or a promotion/demotion handshake) presented a geo epoch
+    at or below a cluster that has already been fenced past it — the
+    deposed-leader case: a follower promoted under a higher geo epoch,
+    so the old leader's writes must be refused, never merged. Maps to
+    HTTP 409; the deposed cluster demotes and re-tails the new leader
+    (mirrors StaleRoutingEpochError, whose max-merge epoch machinery the
+    geo epoch reuses)."""
+
+    message = "stale geo epoch"
+
+    def __init__(self, *args, epoch=None, current=None):
+        super().__init__(*args)
+        self.epoch = epoch      # the epoch the request presented, when known
+        self.current = current  # this cluster's geo epoch
+
+
 class CorruptFragmentError(PilosaError, ValueError):
     """On-disk fragment/bitmap data failed validation (bad cookie, bogus
     container payload, checksum-failing op record). Carries where the file

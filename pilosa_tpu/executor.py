@@ -102,6 +102,12 @@ class ExecOptions:
     # at this CDC position (base image + op replay, cdc/pit.py) instead
     # of live storage. Read-only, node-local, requires cdc.enabled.
     at_position: Optional[int] = None
+    # Bounded-staleness read (geo/, X-Pilosa-Max-Staleness header): on a
+    # geo follower, serve locally only when replication lag <= this many
+    # seconds, else raise StaleReadError (409) carrying the current lag.
+    # No-op on a leader or non-geo node: local state is the source of
+    # truth there, never stale (docs/geo-replication.md).
+    max_staleness: Optional[float] = None
 
 
 class _NoDeviceHealth:
@@ -218,6 +224,11 @@ class Executor:
         # [replication] section (write-consistency ack gating); None =
         # the reference's ack-on-first-apply behavior.
         self.replication_config = None
+        # Geo replication (geo/manager.py), wired by the server when
+        # [geo] role != "none": the read-path staleness gate and the
+        # follower write fence. None (library/single-cluster use) makes
+        # X-Pilosa-Max-Staleness a documented no-op.
+        self.geo = None
         from .logger import NopLogger
 
         self.logger = NopLogger()  # server wires its logger in open()
@@ -285,6 +296,18 @@ class Executor:
         opt = opt or ExecOptions()
         if opt.remote and opt.entry_epoch is None:
             opt.entry_epoch = self.cluster.routing_epoch
+        if opt.max_staleness is not None and self.geo is not None:
+            # Bounded-staleness contract (docs/geo-replication.md):
+            # refuse BEFORE translation/dispatch — a 409 with the current
+            # lag, never a silently-stale answer. Leaders and non-geo
+            # nodes pass unconditionally inside the gate.
+            self.geo.check_staleness(opt.max_staleness)
+        if self.geo is not None and not opt.remote and query.write_calls():
+            # Geo write fence: a follower never accepts an external
+            # write (409 pointing at the leader); a leader tallies the
+            # accepting epoch. Only the external entry is gated —
+            # remote=True forwards were fenced at their coordinator.
+            self.geo.check_write()
 
         for call in query.calls:
             self._translate_call(index, idx, call)

@@ -105,6 +105,7 @@ class API:
         traffic_class: Optional[str] = None,
         epoch: Optional[int] = None,
         at_position: Optional[int] = None,
+        max_staleness: Optional[float] = None,
     ) -> List[Any]:
         """Execute PQL under the query scheduler's lifecycle: admit (429
         when the queue is full) -> wait (bounded by `deadline`) ->
@@ -121,6 +122,7 @@ class API:
             deadline=deadline,
             epoch=epoch,
             at_position=at_position,
+            max_staleness=max_staleness,
         )
         sched = getattr(self.server, "scheduler", None)
         if sched is None:
@@ -227,6 +229,45 @@ class API:
     def cdc_standing_delete(self, sid: str) -> None:
         self._require_cdc().standing.delete(sid)
 
+    # ------------------------------------------------------------------ geo
+
+    @property
+    def geo(self):
+        return getattr(self.server, "geo", None)
+
+    def _require_geo(self):
+        mgr = self.geo
+        if mgr is None:
+            raise ApiError(
+                "geo replication is disabled (set geo.role)")
+        return mgr
+
+    def geo_promote(self) -> dict:
+        """Operator-initiated leader-loss promotion (POST /geo/promote,
+        docs/geo-replication.md): this follower becomes the leader
+        under a bumped fencing geo epoch and starts pushing the demote
+        handshake at the old leader."""
+        return self._require_geo().promote()
+
+    def geo_demote(self, leader: str, epoch: int) -> dict:
+        """Fencing handshake target (POST /geo/demote): re-tail
+        `leader` under the authoritative `epoch`, or 409 when we are
+        already fenced at or past it."""
+        return self._require_geo().demote(leader, epoch)
+
+    def geo_status(self) -> dict:
+        return self._require_geo().status()
+
+    def _geo_check_write(self) -> None:
+        """Import-path write fence: a geo follower refuses external
+        writes with a typed 409 pointing at the leader; a leader
+        tallies the accepting epoch (the split-brain evidence). The
+        tail applies replicated records through apply_hint_ops, which
+        deliberately does NOT pass this gate."""
+        mgr = self.geo
+        if mgr is not None:
+            mgr.check_write()
+
     # --------------------------------------------------------------- schema
 
     def schema(self) -> List[dict]:
@@ -311,6 +352,8 @@ class API:
         path (reference api.go key translation + ctl/import.go -k).
         """
         self._validate("import")
+        if not remote:
+            self._geo_check_write()
         idx = self.holder.index(index)
         if idx is None:
             from ..errors import IndexNotFoundError
@@ -408,6 +451,8 @@ class API:
     def import_values(self, index: str, field: str, shard: int, column_ids, values,
                       remote: bool = False, column_keys=None) -> None:
         self._validate("import")
+        if not remote:
+            self._geo_check_write()
         idx = self.holder.index(index)
         fld = self.holder.field(index, field)
         if fld is None:

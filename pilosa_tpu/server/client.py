@@ -615,6 +615,46 @@ class InternalClient:
         self._request("POST", f"{_node_url(node)}/internal/cluster/message",
                       body, ctype)
 
+    # ------------------------------------------------------------- cdc + geo
+
+    def cdc_stream(self, host, index: str, from_pos: int,
+                   incarnation: Optional[str] = None,
+                   timeout: Optional[float] = None,
+                   max_bytes: Optional[int] = None):
+        """One long-poll chunk of a peer's change stream (GET
+        /cdc/stream — the geo tailer's feed). Returns (raw framed
+        records, lowercased response headers); the caller reads the
+        resume cursor off x-pilosa-cdc-next and the lag anchors off
+        x-pilosa-cdc-head-pos/-time. A 410 ClientError means the cursor
+        fell behind retention (or the index was recreated): re-seed via
+        cdc_bootstrap. Safe to retry: a replayed GET re-reads the same
+        positions."""
+        url = f"{_node_url(host)}/cdc/stream?index={index}&from={int(from_pos)}"
+        if incarnation:
+            qinc = urllib.parse.quote(incarnation, safe="")
+            url += f"&incarnation={qinc}"
+        if timeout is not None:
+            url += f"&timeout={timeout}"
+        if max_bytes is not None:
+            url += f"&max-bytes={int(max_bytes)}"
+        return self._request("GET", url, want_headers=True)
+
+    def cdc_bootstrap(self, host, index: str) -> dict:
+        return json.loads(self._request(
+            "GET", f"{_node_url(host)}/cdc/bootstrap?index={index}"))
+
+    def geo_demote(self, host, leader: str, epoch: int) -> dict:
+        """The fencing handshake (POST /geo/demote): tell a deposed
+        leader it has been fenced at `epoch` and should re-tail
+        `leader`. 409 means the target holds an equal-or-higher epoch."""
+        body = json.dumps({"leader": leader, "epoch": int(epoch)}).encode()
+        return json.loads(self._request(
+            "POST", f"{_node_url(host)}/geo/demote", body))
+
+    def geo_status(self, host) -> dict:
+        return json.loads(self._request(
+            "GET", f"{_node_url(host)}/geo/status"))
+
     def translate_data(self, node, offset: int) -> bytes:
         url = f"{_node_url(node)}/internal/translate/data?offset={offset}"
         return self._request("GET", url)

@@ -135,6 +135,9 @@ class Handler:
             Route("GET", r"/cdc/standing", self.handle_cdc_standing_list),
             Route("GET", r"/cdc/standing/(?P<sid>[^/]+)/poll", self.handle_cdc_standing_poll),
             Route("DELETE", r"/cdc/standing/(?P<sid>[^/]+)", self.handle_cdc_standing_delete),
+            Route("POST", r"/geo/promote", self.handle_geo_promote),
+            Route("POST", r"/geo/demote", self.handle_geo_demote),
+            Route("GET", r"/geo/status", self.handle_geo_status),
             Route("GET", r"/debug/vars", self.handle_debug_vars),
             Route("GET", r"/debug/traces", self.handle_debug_traces),
             Route("GET", r"/metrics", self.handle_metrics),
@@ -238,6 +241,38 @@ class Handler:
                     # (node fault), neither of which should re-route.
                     return (409, "application/json",
                             json.dumps({"error": str(e)}).encode())
+                from ..errors import StaleGeoEpochError, StaleReadError
+
+                if isinstance(e, StaleReadError):
+                    # Bounded-staleness refusal (docs/geo-replication.md):
+                    # a geo follower's replication lag exceeds the
+                    # request's X-Pilosa-Max-Staleness bound. 409 with
+                    # the CURRENT lag so the client can choose — relax
+                    # the bound and re-read here, or fail over to the
+                    # leader. Never a silently-stale answer.
+                    payload = {"error": str(e)}
+                    if e.lag is not None:
+                        payload["lag"] = (e.lag if e.lag != float("inf")
+                                          else None)
+                    if e.bound is not None:
+                        payload["bound"] = e.bound
+                    if e.position is not None:
+                        payload["position"] = e.position
+                    return (409, "application/json",
+                            json.dumps(payload).encode())
+                if isinstance(e, StaleGeoEpochError):
+                    # Geo fence (split-brain guard): a write reached a
+                    # follower, or a demote handshake presented an epoch
+                    # this cluster is already fenced past. 409; a deposed
+                    # leader demotes and re-tails, a client re-routes to
+                    # the leader.
+                    payload = {"error": str(e)}
+                    if e.epoch is not None:
+                        payload["epoch"] = e.epoch
+                    if e.current is not None:
+                        payload["current"] = e.current
+                    return (409, "application/json",
+                            json.dumps(payload).encode())
                 # Missing fragments map to 404 so the anti-entropy client can
                 # treat the replica as empty instead of failing the sync
                 # (reference http/handler.go:776,984,1030).
@@ -408,6 +443,22 @@ class Handler:
             except ValueError:
                 raise PilosaError(
                     f"invalid at-position value: {raw_at!r}") from None
+        # Bounded-staleness read (docs/geo-replication.md): on a geo
+        # follower, answer from local state only when replication lag is
+        # within this many seconds, else 409 with the current lag. On a
+        # leader or non-geo node the header is a clean no-op — local
+        # state is the source of truth, never stale.
+        max_staleness = None
+        raw_stale = headers.get("x-pilosa-max-staleness")
+        if raw_stale:
+            try:
+                max_staleness = float(raw_stale)
+            except ValueError:
+                raise PilosaError(
+                    f"invalid max-staleness value: {raw_stale!r}") from None
+            if max_staleness < 0:
+                raise PilosaError(
+                    f"invalid max-staleness value: {raw_stale!r}")
         remote = query.get("remote", ["false"])[0] == "true"
         column_attrs = query.get("columnAttrs", ["false"])[0] == "true"
         exclude_row_attrs = query.get("excludeRowAttrs", ["false"])[0] == "true"
@@ -460,13 +511,13 @@ class Handler:
             return self._post_query_traced(
                 index, pql, shards, remote, column_attrs, exclude_row_attrs,
                 exclude_columns, deadline, epoch, wants_proto, headers,
-                None, None, at_position)
+                None, None, at_position, max_staleness)
         token = _obs.activate(trace)
         try:
             return self._post_query_traced(
                 index, pql, shards, remote, column_attrs, exclude_row_attrs,
                 exclude_columns, deadline, epoch, wants_proto, headers,
-                recorder, trace, at_position)
+                recorder, trace, at_position, max_staleness)
         except BaseException:
             recorder.finish(trace, status="error")
             raise
@@ -477,7 +528,7 @@ class Handler:
     def _post_query_traced(self, index, pql, shards, remote, column_attrs,
                            exclude_row_attrs, exclude_columns, deadline,
                            epoch, wants_proto, headers, recorder, trace,
-                           at_position=None):
+                           at_position=None, max_staleness=None):
         if wants_proto:
             from . import proto
             from ..errors import PilosaError
@@ -489,6 +540,7 @@ class Handler:
                     exclude_columns=exclude_columns,
                     deadline=deadline,
                     at_position=at_position,
+                    max_staleness=max_staleness,
                 )
             except PilosaError as e:
                 from ..sched import DeadlineExceededError, QueueFullError
@@ -505,7 +557,8 @@ class Handler:
         if remote:
             results = self.api.query(index, pql, shards=shards, remote=True,
                                      deadline=deadline, epoch=epoch,
-                                     at_position=at_position)
+                                     at_position=at_position,
+                                     max_staleness=max_staleness)
             from . import wire
 
             extra = {}
@@ -533,6 +586,7 @@ class Handler:
             index, pql, shards=shards, column_attrs=column_attrs,
             exclude_row_attrs=exclude_row_attrs, exclude_columns=exclude_columns,
             deadline=deadline, at_position=at_position,
+            max_staleness=max_staleness,
         )
 
     def _column_attr_sets(self, index, results):
@@ -656,9 +710,16 @@ class Handler:
         data, nxt, incarnation = self.api.cdc_stream(
             index, from_pos, incarnation=inc, timeout=timeout,
             max_bytes=max_bytes)
+        # Lag anchors for geo followers (docs/geo-replication.md): the
+        # newest assigned position and THIS node's wall clock, read
+        # together, so the consumer computes staleness entirely from
+        # leader-side times (its own clock never enters the formula).
+        head_pos, head_time = self.api.server.cdc.head(index)
         return (200, "application/octet-stream", data,
                 {"X-Pilosa-Cdc-Next": str(nxt),
-                 "X-Pilosa-Cdc-Incarnation": incarnation})
+                 "X-Pilosa-Cdc-Incarnation": incarnation,
+                 "X-Pilosa-Cdc-Head-Pos": str(head_pos),
+                 "X-Pilosa-Cdc-Head-Time": repr(head_time)})
 
     def handle_cdc_bootstrap(self, query, **kw):
         """GET /cdc/bootstrap?index=X — snapshot re-seed for a consumer
@@ -693,6 +754,31 @@ class Handler:
     def handle_cdc_standing_delete(self, sid, **kw):
         self.api.cdc_standing_delete(sid)
         return {}
+
+    # ------------------------------------------------------------------ geo
+
+    def handle_geo_promote(self, **kw):
+        """POST /geo/promote — operator-initiated leader-loss promotion
+        (docs/geo-replication.md): this follower becomes the leader
+        under a bumped fencing geo epoch. Idempotent on a leader."""
+        return self.api.geo_promote()
+
+    def handle_geo_demote(self, body, **kw):
+        """POST /geo/demote {"leader": uri, "epoch": n} — the fencing
+        handshake: re-tail `leader` under the authoritative epoch, or
+        409 when already fenced at or past it."""
+        req = _json_body(body)
+        leader = req.get("leader")
+        if not leader:
+            raise PilosaError("leader required")
+        try:
+            epoch = int(req["epoch"])
+        except (KeyError, TypeError, ValueError):
+            raise PilosaError("valid epoch required") from None
+        return self.api.geo_demote(leader, epoch)
+
+    def handle_geo_status(self, **kw):
+        return self.api.geo_status()
 
     def handle_post_block_data(self, query, body, **kw):
         data = _json_body(body)
@@ -914,6 +1000,13 @@ class Handler:
         cdc = getattr(self.api.server, "cdc", None)
         if cdc is not None:
             out["cdc"] = cdc.debug_vars()
+        # Geo replication (docs/geo-replication.md): role/epoch, per-link
+        # tail positions + lag, breaker state, promotion/demotion/fence
+        # counters — the on-call question is "how far behind is this
+        # follower, and who holds the fencing epoch".
+        geo = getattr(self.api.server, "geo", None)
+        if geo is not None:
+            out["geo"] = geo.debug_vars()
         # Per-query tracing health (docs/observability.md): sampler
         # counters, ring depth, slow-query count — the aggregate next to
         # the per-trace detail /debug/traces serves.

@@ -71,6 +71,7 @@ class Server:
         tier_config=None,
         obs_config=None,
         cdc_config=None,
+        geo_config=None,
         join_addr: Optional[str] = None,
         allowed_origins: Optional[List[str]] = None,
         tls_certificate: Optional[str] = None,
@@ -299,6 +300,28 @@ class Server:
             self.obs_config, stats=self.stats, logger=self.logger,
         )
         self.api = API(self)
+        # Geo replication (geo/, docs/geo-replication.md): follower
+        # clusters tail this (or another) cluster's CDC stream. Built
+        # after the API (the tailer applies through api.apply_hint_ops)
+        # with its OWN client — tail long-polls must not contend with
+        # the executor's fan-out pool. None = [geo] role "none".
+        from ..geo import GeoConfig
+
+        self.geo_config = (geo_config or GeoConfig()).validate()
+        self.geo = None
+        if self.geo_config.role != "none":
+            from ..geo.manager import GeoManager
+
+            self.geo = GeoManager(
+                self,
+                self.geo_config,
+                os.path.join(data_dir, "geo") if data_dir else None,
+                storage_config=storage_config,
+                client=InternalClient(
+                    skip_verify=tls_skip_verify, key=self.internal_key,
+                ),
+            )
+            self.executor.geo = self.geo
         self.handler = Handler(
             self.api, logger=self.logger, allowed_origins=allowed_origins,
             internal_key=self.internal_key,
@@ -486,6 +509,11 @@ class Server:
             # the source of truth for who must rejoin — don't clobber it
             # with the partial membership.
             self.topology.save(self.cluster.nodes)
+        if self.geo is not None:
+            # After the HTTP plane is up (the fence thread advertises
+            # node.uri, which is final only post-bind) and the holder is
+            # open (the tailer applies into live fragments).
+            self.geo.start()
         self.opened = True
         if self.join_addr:
             self._join_cluster()
@@ -696,6 +724,16 @@ class Server:
 
     def close(self) -> None:
         self._stop.set()
+        if self.cdc is not None:
+            # Unpark /cdc/stream long-poll waiters BEFORE the HTTP
+            # shutdown: a handler thread blocked in a stream wait would
+            # otherwise pin shutdown() until its poll timeout expires.
+            # The logs stay open; this only releases parked readers.
+            self.cdc.interrupt()
+        if self.geo is not None:
+            # Stop tailing/fencing before the holder flushes: the tail
+            # thread applies into live fragments.
+            self.geo.close()
         for t in self._threads:
             t.join(timeout=2.0)
         if self._httpd:

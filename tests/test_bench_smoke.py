@@ -96,7 +96,7 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     assert not detail.get("partial"), detail.get("partial")
     assert parsed["value"] > 0
     stanzas = _registered_stanzas()
-    assert len(stanzas) >= 20  # the registry itself didn't shrink
+    assert len(stanzas) >= 21  # the registry itself didn't shrink
     for name in stanzas:
         stanza = detail.get(name.lower())
         assert isinstance(stanza, dict), f"stanza {name} missing: {stanza}"
@@ -237,6 +237,31 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     assert obs["traced_all"], obs
     obs = _retry_ratio_gate("OBS", obs, lambda o: o["obs_ok"], tmp_path)
     assert obs["obs_ok"], obs
+    # The GEO stanza is the geo-replication acceptance metric
+    # (docs/geo-replication.md): across a leader SIGKILL + follower
+    # promotion + old-leader rejoin (fenced, demoted, re-tailed), ZERO
+    # acked writes may be lost on EITHER cluster and the two clusters'
+    # fragments must be byte-identical; the staleness contract must
+    # serve in-bound reads locally and 409 an unsatisfiable bound; the
+    # promotion must bump the geo epoch and the fence must land. All
+    # correctness gates — never retried. The replication-lag
+    # percentiles are timing gates: one isolation rerun per the TIER-
+    # flake precedent.
+    geo = detail["geo"]
+    assert geo["lost_acked_writes"] == 0, geo
+    assert geo["byte_identical"], geo
+    assert geo["caught_up"], geo
+    assert geo["stale_409_seen"], geo
+    assert geo["promoted_epoch"] >= 1, geo
+    assert geo["demoted"], geo
+    assert geo["converged"], geo
+    assert geo["geo_ok"], geo
+    geo = _retry_ratio_gate(
+        "GEO", geo,
+        lambda g: g["lag_samples"] > 0 and g["lag_p99_ms"] < 5000,
+        tmp_path)
+    assert geo["lag_samples"] > 0, geo
+    assert geo["lag_p99_ms"] < 5000, geo
 
     # BENCH_OUT got the same line atomically.
     out_path = tmp_path / "bench_out.json"

@@ -611,3 +611,133 @@ def test_sigkill_mid_stream_consumer_resumes_loss_free(tmp_path):
         assert got.to_bytes() == frag_of(s).storage.to_bytes()
     finally:
         s.close()
+
+
+def test_long_poll_consumer_observes_server_close(tmp_path):
+    """Shutdown regression: a consumer parked in the stream long-poll
+    must observe Server.close() promptly. close() interrupts the CDC
+    log waiters BEFORE joining HTTP handler threads, so shutdown never
+    has to wait out a poll timeout — the parked request returns empty
+    at its cursor (a normal resumable response, not an error)."""
+    s = make_server(tmp_path, open_http=True)
+    closed = False
+    try:
+        s.api.create_index("i")
+        s.api.create_field("i", "f")
+        s.api.query("i", "Set(1, f=1)")
+        base = f"http://localhost:{s.port}"
+        out = {}
+        started = threading.Event()
+
+        def consume():
+            # Parked at the head: nothing past position 1 is coming.
+            started.set()
+            out["r"] = _get(f"{base}/cdc/stream?index=i&from=1&timeout=60",
+                            timeout=90)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        assert started.wait(5)
+        time.sleep(0.3)  # let the request actually park in the wait
+        t0 = time.monotonic()
+        s.close()
+        closed = True
+        took = time.monotonic() - t0
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert took < 15.0, f"close() waited out the long-poll: {took:.1f}s"
+        st, hdr, data = out["r"]
+        assert st == 200 and data == b""
+        assert int(hdr["X-Pilosa-Cdc-Next"]) == 1  # cursor unchanged
+    finally:
+        if not closed:
+            s.close()
+
+
+def test_bootstrap_racing_compaction_consistent_cut(tmp_path):
+    """A bootstrap whose image serialization races the retention fold
+    must still hand the consumer a consistent (base image, cut
+    position) pair: replaying the stream from the returned cursor over
+    the images reproduces the live fragment byte-for-byte, with dense
+    positions (no gap) and no double-apply (the workload mixes Set and
+    Clear, so a replayed stale record would corrupt the bytes). If the
+    fold outruns the pinned cut the consumer sees a clean 410 and
+    re-seeds — never a silent gap. The `cdc-snapshot-bootstrap`
+    latency failpoint holds the serialization window open while the
+    writer forces folds through it."""
+    import random
+
+    s = make_server(tmp_path, retention_ops=8)
+    try:
+        idx = s.holder.create_index("i")
+        idx.create_field("f")
+        rng = random.Random(1337)  # seed-pinned interleave
+        writes = 0
+
+        def write_one():
+            nonlocal writes
+            col = rng.randrange(64)
+            if rng.random() < 0.3:
+                s.api.query("i", f"Clear({col}, f=1)")
+            else:
+                s.api.query("i", f"Set({col}, f=1)")
+            writes += 1
+
+        for _ in range(40):
+            write_one()
+        log = s.cdc.log("i")
+        assert log.compactions >= 1  # folds really happen at this scale
+
+        stop = threading.Event()
+
+        def writer():
+            while not stop.is_set():
+                write_one()
+                time.sleep(0.002)
+
+        # Hold each bootstrap's off-lock serialization window open so
+        # the writer drives retention folds straight through it.
+        failpoints.configure("cdc-snapshot-bootstrap", "latency", arg=150)
+        w = threading.Thread(target=writer)
+        w.start()
+        try:
+            boots = [s.cdc.bootstrap("i") for _ in range(3)]
+        finally:
+            stop.set()
+            w.join(timeout=30)
+            failpoints.deactivate("cdc-snapshot-bootstrap")
+        final = s.cdc.log("i").last_pos
+        assert final > 40  # the race window saw live writes
+        frag = frag_of(s)
+        frag.snapshot()  # quiesce before byte compares
+        want = frag.storage.to_bytes()
+
+        for boot in boots:
+            bm = Bitmap()
+            for fr in boot["fragments"]:
+                bm = Bitmap.from_bytes(zlib.decompress(
+                    base64.b64decode(fr["data"])))
+            cur, inc = boot["from"], boot["incarnation"]
+            for _ in range(10):
+                try:
+                    data, cur, inc = s.cdc.stream("i", cur, inc, timeout=0)
+                except CdcGoneError:
+                    # The fold outran this cut: typed 410, clean re-seed
+                    # — the documented recovery, never a silent gap.
+                    boot2 = s.cdc.bootstrap("i")
+                    for fr in boot2["fragments"]:
+                        bm = Bitmap.from_bytes(zlib.decompress(
+                            base64.b64decode(fr["data"])))
+                    cur, inc = boot2["from"], boot2["incarnation"]
+                    continue
+                got = [r.position for r, _ in decode_cdc_records(data)]
+                # Dense from the cursor: no gap, no double-delivery.
+                assert got == list(range(cur - len(got) + 1, cur + 1))
+                for r, _ in decode_cdc_records(data):
+                    replay_ops(bm, r.ops)
+                if cur == final:
+                    break
+            assert cur == final
+            assert bm.to_bytes() == want
+    finally:
+        _close(s)

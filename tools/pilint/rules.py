@@ -211,6 +211,7 @@ JAX_FREE_ZONES = (
     "pilosa_tpu/obs/",
     "pilosa_tpu/plan/",
     "pilosa_tpu/cdc/",
+    "pilosa_tpu/geo/",
 )
 
 
@@ -1469,6 +1470,7 @@ R11_SECTIONS: Dict[str, Tuple[str, str, str, str]] = {
                           "docs/durability.md"),
     "ObsConfig": ("obs", "obs", "OBS", "docs/observability.md"),
     "CdcConfig": ("cdc", "cdc", "CDC", "docs/cdc.md"),
+    "GeoConfig": ("geo", "geo", "GEO", "docs/geo-replication.md"),
 }
 CONFIG_FILE = "pilosa_tpu/config.py"
 CLI_FILE = "pilosa_tpu/cli.py"
