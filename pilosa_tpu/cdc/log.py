@@ -417,6 +417,7 @@ class CdcLog:
         # Fold the dropped prefix into the base images, batched per
         # fragment (records replay in position order within the prefix).
         folds: Dict[str, Tuple[int, Bitmap]] = {}
+        new_base = self.base_pos
         for rec, _end in decode_cdc_records(prefix):
             key = _frag_key(rec.field, rec.view, rec.shard)
             got = folds.get(key)
@@ -428,6 +429,14 @@ class CdcLog:
             replay_ops(bm, rec.ops)
             folds[key] = (rec.position, bm)
             new_base = rec.position
+        if not folds:
+            # Zero records decoded from a prefix _offsets says holds
+            # `drop` of them: the in-memory index and the log bytes
+            # disagree. Dropping the offsets anyway would corrupt the
+            # stream; skip this compaction and surface the anomaly.
+            self.counters["cdc_compact_skipped"] = \
+                self.counters.get("cdc_compact_skipped", 0) + 1
+            return
         for key, (cut_pos, bm) in folds.items():
             self._set_base_locked(key, cut_pos, bm.to_bytes())
         # Drop the prefix from the log and rebase the offsets.
@@ -518,10 +527,29 @@ class CdcLog:
                 raise CdcGoneError(
                     f"index {self.index!r} dropped mid-stream",
                     incarnation=self.incarnation)
+            # Re-validate under the SAME lock hold before bisecting:
+            # while this reader was parked, an append may have triggered
+            # compaction that folded positions past from_pos (base_pos
+            # advanced). The entry-time check above predates that fold;
+            # reading on regardless would silently skip the folded span
+            # — a replication gap with no 410/bootstrap signal.
+            self.check_cursor_locked(from_pos, inc)
             # First retained record with position > from_pos.
             i = bisect.bisect_right([p for p, _ in self._offsets], from_pos)
             if i >= len(self._offsets):
-                return b"", self.last_pos
+                if self.last_pos > from_pos:
+                    # Positions past the cursor exist but none are
+                    # retained: everything after from_pos was folded.
+                    # Jumping the cursor to last_pos here would silently
+                    # drop those records — route to bootstrap instead.
+                    raise CdcGoneError(
+                        f"cursor {from_pos} of index {self.index!r} fell "
+                        f"behind retention (positions through "
+                        f"{self.last_pos} were folded into base images); "
+                        "re-bootstrap",
+                        first=self.base_pos + 1, last=self.last_pos,
+                        incarnation=self.incarnation)
+                return b"", from_pos
             start = self._offsets[i][1]
             j = i
             while j + 1 < len(self._offsets) and \
@@ -536,6 +564,19 @@ class CdcLog:
                     upto: int) -> bytes:
         """Concatenated WAL op bytes of one fragment's retained records
         with position <= upto, in position order — the PIT replay tail."""
+        return self.base_and_records_for(field, view, shard, upto)[1]
+
+    def base_and_records_for(self, field: str, view: str, shard: int,
+                             upto: int):
+        """Atomic (base image, replay tail) snapshot for PIT
+        materialization: the base and the retained log bytes are read
+        under ONE lock hold, so a compaction cannot fold records between
+        the two reads. Read separately, the folded span (old_cut,
+        new_cut] would land in neither the stale base nor the tail — a
+        silently wrong historical fragment. Returns (base, ops) where
+        base is (cut_pos, roaring bytes) or None and ops is the
+        concatenated WAL op bytes with position <= upto."""
+        key = _frag_key(field, view, shard)
         with self.lock:
             if upto < self.base_pos:
                 raise CdcGoneError(
@@ -544,6 +585,7 @@ class CdcLog:
                     f"{self.base_pos + 1})",
                     first=self.base_pos + 1, last=self.last_pos,
                     incarnation=self.incarnation)
+            base = self._base_locked(key)
             data = self._read_locked(0, self.size)
         out = []
         for rec, _end in decode_cdc_records(data):
@@ -552,7 +594,7 @@ class CdcLog:
             if rec.field == field and rec.view == view \
                     and rec.shard == shard:
                 out.append(rec.ops)
-        return b"".join(out)
+        return base, b"".join(out)
 
     # ------------------------------------------------------------ counters
 

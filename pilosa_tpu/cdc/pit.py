@@ -52,7 +52,13 @@ class PitCache:
                 self.hits += 1
                 return got
             self.misses += 1
-        base = log.base(field, view, shard)
+        # Base image and replay tail in ONE log-lock critical section
+        # (410s when P itself fell behind the fold line): a compaction
+        # between separate base()/records_for() calls could fold
+        # records into a newer base and drop them from the log, leaving
+        # the folded span in neither the stale base read first nor the
+        # tail read second.
+        base, ops = log.base_and_records_for(field, view, shard, position)
         if base is not None and base[0] > position:
             # The base was cut AFTER the requested position (data that
             # predates change capture, or a fold past it): the state at
@@ -63,8 +69,6 @@ class PitCache:
                 f"{base[0]})",
                 first=base[0], last=log.last_pos,
                 incarnation=log.incarnation)
-        # records_for 410s when P itself fell behind the fold line.
-        ops = log.records_for(field, view, shard, position)
         frag = Fragment(None, index, field, view, shard)
         frag.open()
         if base is not None:

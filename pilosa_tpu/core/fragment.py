@@ -735,6 +735,21 @@ class Fragment:
                 os.fsync(self._wal.fileno())
                 self._unsynced_ops = 0
 
+    def wal_sync(self) -> None:
+        """Force any batch-deferred WAL appends to disk NOW. For callers
+        that durably checkpoint external progress against this
+        fragment's state (the geo tail cursor): the checkpoint may only
+        claim positions whose WAL records are actually synced, or a
+        crash loses the WAL tail while the checkpoint says those
+        positions were applied — a gap that is never re-fetched."""
+        with self._mu:
+            if self._wal is not None and self._unsynced_ops \
+                    and self.storage_config.fsync != FSYNC_NEVER:
+                self._wal.flush()
+                # pilint: allow-blocking(checkpoint ordering boundary: the geo cursor must not durably claim positions whose WAL records are still page-cache-only)
+                os.fsync(self._wal.fileno())
+                self._unsynced_ops = 0
+
     # ---------------------------------------------------- snapshot triggers
 
     def snapshot_due(self) -> bool:

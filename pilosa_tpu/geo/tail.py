@@ -100,8 +100,8 @@ class GeoTailer:
         self._probe_strikes = 0        # consecutive failed contacts
         self.counters: Dict[str, int] = {
             "polls": 0, "records_applied": 0, "bytes_applied": 0,
-            "bootstraps": 0, "link_failures": 0, "apply_errors": 0,
-            "checkpoints": 0, "schema_syncs": 0,
+            "bootstraps": 0, "bootstrap_cleared": 0, "link_failures": 0,
+            "apply_errors": 0, "checkpoints": 0, "schema_syncs": 0,
         }
 
     # ------------------------------------------------------------ lifecycle
@@ -306,7 +306,7 @@ class GeoTailer:
             return False
         self._contact_ok()
         try:
-            applied = self._apply_chunk(link, data)
+            applied, touched = self._apply_chunk(link, data)
         except Exception:
             # Partial application is safe (cursor not advanced, replay
             # is idempotent) but back off: a poisoned record would
@@ -332,21 +332,40 @@ class GeoTailer:
         link.failures = 0
         link.backoff = 0.0
         link.next_attempt = 0.0
+        # The docstring's 'applied DURABLY first' ordering: with
+        # fsync=batch the chunk's WAL appends may still be page-cache-
+        # only, and durably replacing the cursor over an unsynced WAL
+        # tail is exactly the advanced-cursor-over-unapplied-state gap
+        # the contract forbids. Force the touched WAL tails down first.
+        self._sync_touched(touched)
         self._checkpoint(link)
         return bool(data)
 
     def _apply_chunk(self, link: _Link, data: bytes):
         api = self.manager.server.api
         last = None
+        touched = set()
         for rec, _ in decode_cdc_records(data):
             failpoints.fire("geo-apply")
             api.apply_hint_ops(rec.index, rec.field, rec.view, rec.shard,
                                rec.ops)
+            touched.add((rec.index, rec.field, rec.view, rec.shard))
             last = rec
             link.records += 1
             self.counters["records_applied"] += 1
         self.counters["bytes_applied"] += len(data)
-        return last
+        return last, touched
+
+    def _sync_touched(self, touched) -> None:
+        """fsync the WAL of every fragment a chunk touched, BEFORE the
+        cursor checkpoint claims its positions. No-op under
+        fsync=always (already synced per op) and fsync=never (the
+        operator opted out of durability entirely)."""
+        holder = self.manager.server.holder
+        for index, field, view, shard in touched:
+            frag = holder.fragment(index, field, view, shard)
+            if frag is not None:
+                frag.wal_sync()
 
     def _bootstrap_link(self, leader: str, link: _Link) -> bool:
         """410 recovery: install the leader's base images wholesale and
@@ -378,6 +397,7 @@ class GeoTailer:
                 raw = zlib.decompress(base64.b64decode(spec["data"]))
                 frag.migrate_install(raw)
                 frag.migrate_seal()
+            self._clear_divergent(link.index, resp.get("fragments", []))
         except Exception:
             logger.exception("geo bootstrap install failed for index %r",
                              link.index)
@@ -399,6 +419,36 @@ class GeoTailer:
         self.counters["bootstraps"] += 1
         self._checkpoint(link)
         return True
+
+    def _clear_divergent(self, index: str, specs) -> None:
+        """Bootstrap is documented as REPLACING local state with the
+        new leader's view — which must include local fragments the
+        response does NOT carry: divergent writes a deposed leader
+        accepted before the fence landed, or data since deleted on the
+        new leader. Left alone, a demoted cluster would serve that
+        divergent data forever. Install an empty base over each (the
+        leader's view of a fragment it didn't ship IS empty); replay
+        from the cut position reconverges anything live."""
+        from ..storage.bitmap import Bitmap
+
+        want = {(s["field"], s["view"], s["shard"]) for s in specs}
+        holder = self.manager.server.holder
+        idx = holder.index(index)
+        if idx is None:
+            return
+        empty = Bitmap().to_bytes()
+        for field in list(idx.fields.values()):
+            for view in list(field.views.values()):
+                for frag in list(view.fragments.values()):
+                    if (frag.field, frag.view, frag.shard) in want:
+                        continue
+                    frag.migrate_install(empty)
+                    frag.migrate_seal()
+                    self.counters["bootstrap_cleared"] += 1
+                    logger.info(
+                        "geo bootstrap cleared divergent fragment "
+                        "%s/%s/%s/%s (absent from leader bootstrap)",
+                        index, frag.field, frag.view, frag.shard)
 
     # ------------------------------------------------------------- breakers
 

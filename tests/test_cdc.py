@@ -183,6 +183,46 @@ def test_retention_fold_410_and_bootstrap_bit_exact(tmp_path):
         _close(s)
 
 
+def test_parked_long_poll_410s_when_fold_passes_cursor(tmp_path):
+    """A reader parked in the long-poll wait re-validates its cursor
+    after waking: the append that wakes it can trigger compaction that
+    folds positions past the cursor IN THE SAME lock hold. Reading on
+    from the rebased offsets would silently skip the folded span (or
+    jump the cursor to last_pos with no data) — the reader must get the
+    typed 410 and re-seed via bootstrap, never a silent gap."""
+    s = make_server(tmp_path, retention_ops=1)
+    try:
+        idx = s.holder.create_index("i")
+        idx.create_field("f")
+        s.api.query("i", "Set(1, f=1)")  # pos 1; ops=1, no fold yet
+        log = s.cdc.log("i")
+        assert log.base_pos == 0 and log.last_pos == 1
+        out = {}
+
+        def consume():
+            try:
+                out["r"] = s.cdc.stream("i", 1, log.incarnation, timeout=10)
+            except CdcGoneError as e:
+                out["gone"] = e
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.2)  # reader parked at the head (cursor == last_pos)
+        # ops crosses retention_ops=1: this append folds BOTH records
+        # into base images under the same lock hold, then wakes the
+        # parked reader — whose entry-time cursor check predates the
+        # fold.
+        s.api.query("i", "Set(2, f=1)")
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert log.base_pos == 2  # the fold really passed the cursor
+        e = out.get("gone")
+        assert e is not None, f"expected 410, got chunk {out.get('r')!r}"
+        assert e.last == 2
+    finally:
+        _close(s)
+
+
 def test_positions_survive_restart_and_snapshot_splice(tmp_path):
     """The change log is its own artifact: fragment WAL splicing (the
     background snapshotter) and a full server restart neither renumber

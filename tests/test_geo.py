@@ -202,6 +202,53 @@ def test_bootstrap_on_incarnation_change(pair):
     assert frag_bytes(follower) == frag_bytes(leader)
 
 
+def test_bootstrap_clears_divergent_fragments(pair):
+    """Bootstrap REPLACES local state with the leader's view — including
+    fragments the response does NOT carry. Data from the old index life
+    that the new leader never wrote (here: a shard-1 fragment) must be
+    cleared by the re-seed, not served forever."""
+    leader, follower = pair
+    leader.api.query("i", f"Set({SHARD_WIDTH + 2}, f=1)")  # shard 1
+    leader.api.query("i", "Set(1, f=1)")                   # shard 0
+    wait_until(lambda: count_row(follower) == 2, msg="first life")
+    leader.api.delete_index("i")
+    leader.api.create_index("i")
+    leader.api.create_field("i", "f")
+    leader.api.query("i", "Set(2, f=1)")  # shard 0 only in the new life
+    wait_until(lambda: follower.geo.tailer.counters["bootstraps"] >= 1,
+               msg="bootstrap")
+    # Without divergence clearing the stale shard-1 bit lingers and the
+    # count stays 2 forever.
+    wait_until(lambda: count_row(follower) == 1, msg="second life")
+    assert follower.geo.tailer.counters["bootstrap_cleared"] >= 1
+    frag = follower.holder.fragment("i", "f", "standard", 1)
+    assert frag is None or frag.storage.count() == 0
+    assert frag_bytes(follower) == frag_bytes(leader)
+
+
+def test_checkpoint_implies_synced_wal(pair):
+    """The cursor checkpoint durably claims its chunk's positions, so
+    the fragment WAL tails it covers must be fsynced first. Under the
+    default fsync=batch policy the applied records would otherwise sit
+    in the page cache (batch threshold not reached) while the cursor
+    file is already durably replaced — a crash in that window loses a
+    tail the cursor says was applied, a gap never re-fetched."""
+    leader, follower = pair
+    for col in range(10):
+        leader.api.query("i", f"Set({col}, f=1)")
+    wait_until(lambda: count_row(follower) == 10, msg="converge")
+
+    def synced():
+        frag = follower.holder.fragment("i", "f", "standard", 0)
+        return frag is not None \
+            and frag.storage_config.fsync == "batch" \
+            and follower.geo.tailer.counters["checkpoints"] >= 1 \
+            and frag._unsynced_ops == 0
+    # 10 applied ops < fsync_batch_ops=64: without the pre-checkpoint
+    # wal_sync the counter would sit at 10 indefinitely.
+    wait_until(synced, msg="WAL synced before checkpoint")
+
+
 # ------------------------------------------------------ staleness contract
 
 
