@@ -235,12 +235,63 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--sched-default-deadline", dest="sched_default_deadline",
                    type=float, help="default per-query budget in seconds (0 = none)")
     p.add_argument("--sched-retry-after", dest="sched_retry_after", type=float)
+    p.add_argument("--sched-retry-jitter", dest="sched_retry_jitter",
+                   type=float,
+                   help="±fraction applied to derived Retry-After values "
+                        "so shed clients don't return in lockstep "
+                        "(clamped to [0, 1])")
     p.add_argument("--sched-batch-window", dest="sched_batch_window", type=float,
                    help="micro-batch base window in seconds")
     p.add_argument("--sched-batch-window-max", dest="sched_batch_window_max",
                    type=float)
     p.add_argument("--sched-batch-max", dest="sched_batch_max", type=int,
                    help="max queries coalesced into one device launch")
+    p.add_argument("--qos-rate", dest="qos_rate", type=float,
+                   help="per-tenant budget refill: ms of measured query "
+                        "cost per second per unit share (0 disables QoS)")
+    p.add_argument("--qos-burst", dest="qos_burst", type=float,
+                   help="tenant bucket capacity in ms of measured cost "
+                        "at share 1.0")
+    p.add_argument("--qos-default-tenant-share",
+                   dest="qos_default_tenant_share", type=float,
+                   help="rate/burst multiplier for tenants with no "
+                        "explicit share override")
+    p.add_argument("--qos-interactive-cap", dest="qos_interactive_cap",
+                   type=float,
+                   help="interactive queries shed only past this "
+                        "multiple of the tenant's burst in debt")
+    p.add_argument("--qos-estimate-ms", dest="qos_estimate_ms", type=float,
+                   help="static cost charged at admission, reconciled "
+                        "to the traced cost at query end")
+    p.add_argument("--autoscale-interval", dest="autoscale_interval",
+                   type=float,
+                   help="seconds between autoscale control steps "
+                        "(0 disables the controller)")
+    p.add_argument("--autoscale-window", dest="autoscale_window", type=int,
+                   help="consecutive agreeing samples required before a "
+                        "scale decision")
+    p.add_argument("--autoscale-scale-out-qps",
+                   dest="autoscale_scale_out_qps", type=float,
+                   help="cluster-wide qps high watermark for scale-out")
+    p.add_argument("--autoscale-scale-in-qps",
+                   dest="autoscale_scale_in_qps", type=float,
+                   help="qps low watermark for scale-in (the gap below "
+                        "scale-out-qps is the anti-flap dead band)")
+    p.add_argument("--autoscale-p99-ms", dest="autoscale_p99_ms", type=float,
+                   help="optional stage-p99 latency trigger in ms "
+                        "(0 ignores latency)")
+    p.add_argument("--autoscale-cooldown", dest="autoscale_cooldown",
+                   type=float,
+                   help="seconds after a scale action before the next")
+    p.add_argument("--autoscale-min-nodes", dest="autoscale_min_nodes",
+                   type=int, help="never scale in below this many nodes")
+    p.add_argument("--autoscale-max-nodes", dest="autoscale_max_nodes",
+                   type=int,
+                   help="never scale out past this many nodes "
+                        "(0 = bounded by the standby pool)")
+    p.add_argument("--autoscale-standby", dest="autoscale_standby",
+                   help="comma-separated host:port URIs of running "
+                        "standby servers scale-out may admit")
     p.add_argument("--storage-fsync", dest="storage_fsync",
                    choices=["never", "batch", "always"],
                    help="WAL/snapshot durability: never (page cache only), "

@@ -160,6 +160,26 @@ class Cluster:
             self.migrated.add((index, shard))
             self._advance_epoch(epoch)
 
+    def revert_cutover(self, index: str, shard: int,
+                       epoch: Optional[int] = None) -> None:
+        """Reverse migration (autoscale abort, docs/rebalance.md): flip
+        one committed shard's routing BACK to the prior topology after
+        its data has been streamed back to the prior owners. The inverse
+        of apply_cutover; idempotent the same way."""
+        with self._routing_mu:
+            if self.next_nodes is None:
+                if epoch is not None:
+                    self.routing_epoch = max(self.routing_epoch, epoch)
+                return
+            if (index, shard) not in self.migrated:
+                # Late/duplicate revert; still merge an authoritative
+                # epoch so this node doesn't fall behind.
+                if epoch is not None:
+                    self.routing_epoch = max(self.routing_epoch, epoch)
+                return
+            self.migrated.discard((index, shard))
+            self._advance_epoch(epoch)
+
     def commit_topology(self, new_nodes: Optional[List[Node]] = None,
                         epoch: Optional[int] = None) -> None:
         """Job completion: the target membership becomes THE membership

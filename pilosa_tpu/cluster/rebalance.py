@@ -160,6 +160,10 @@ class RebalanceStats:
             "shards_cut_over": 0,
             "cutover_pause_overruns": 0,  # freeze->commit > cutover-pause-max
             "stale_epoch_reroutes": 0,
+            # Reverse migration (abort with full restore, docs/rebalance.md)
+            "jobs_revert_started": 0,
+            "jobs_reverted": 0,
+            "shards_reverted": 0,
         }
         self.fragments_pending = 0
         self._pauses: deque = deque(maxlen=self._PAUSE_WINDOW)
@@ -720,7 +724,17 @@ class RebalanceReceiver:
         self._close_sessions(st)
         self._stats.add("fragments_moved", len(st.frag_states))
         self._stats.add_pending(-len(st.frag_states))
-        self.server.cluster.apply_cutover(index, shard)
+        if msg.get("revert"):
+            # Reverse migration (docs/rebalance.md): the shard's data
+            # just streamed BACK to this prior owner. Thaw the local
+            # fragments (frozen since the forward cutover — the freeze
+            # is what made the copy byte-faithful) and flip routing back
+            # to the prior topology for this shard.
+            for fs in st.frag_states:
+                fs[2]._moved = False
+            self.server.cluster.revert_cutover(index, shard)
+        else:
+            self.server.cluster.apply_cutover(index, shard)
         self._notify_coordinator({
             "type": "rebalance-shard-done", "jobID": job_id,
             "index": index, "shard": shard, "nodeID": self.server.node.id,
@@ -779,8 +793,15 @@ class RebalanceJob:
     def __init__(self, job_id: str, new_nodes: List[Node],
                  moves: Dict[str, List[dict]],
                  committed: Optional[Set[Tuple[str, int]]] = None,
-                 attempt: int = 0):
+                 attempt: int = 0, revert: bool = False):
         self.id = job_id
+        # Reverse-migration job (docs/rebalance.md): moves stream
+        # committed shards from the TARGET owners back to the PRIOR
+        # owners, `committed` counts shards already flipped BACK, and
+        # completion fully reverts routing instead of committing the
+        # target topology. new_nodes still names the target membership —
+        # the URI pool for reaching the reverse-stream sources.
+        self.revert = revert
         # Delivery attempt (bumped per resume): rides instruction
         # messages so a re-sent instruction for a resumed job is not
         # swallowed by the receivers' duplicate-delivery dedupe.
@@ -812,6 +833,11 @@ class RebalanceJob:
         self.done: Dict[Tuple[str, int], Set[str]] = {}
         self.committed: Set[Tuple[str, int]] = set(committed or ())
         self.frozen: Set[Tuple[str, int]] = set()
+        # Revert jobs only: shards whose forward cutover is still in
+        # force (routing to the target owners). Shrinks as reverse
+        # cutovers flip shards back; THIS set is what the checkpoint
+        # persists, so a resumed revert re-reverts exactly what's left.
+        self.revert_remaining: Set[Tuple[str, int]] = set()
         self.lock = threading.Lock()
 
     def pending_shards(self) -> List[Tuple[str, int]]:
@@ -828,6 +854,12 @@ class RebalanceCoordinator:
     def __init__(self, server):
         self.server = server
         self.job: Optional[RebalanceJob] = None
+        # Autoscaler contract (cluster/autoscale.py): set before an
+        # autoscale-initiated begin() so EVERY abort path of that job —
+        # operator abort, shard failure, instruction delivery failure —
+        # escalates to a reverse migration instead of leaving mixed
+        # routing behind. Cleared when the job (or its revert) finishes.
+        self.revert_on_abort = False
         self._lock = threading.Lock()
         # Serializes checkpoint writes: concurrent shard_done handlers
         # racing tmp+rename on the same path would FileNotFoundError.
@@ -970,7 +1002,10 @@ class RebalanceCoordinator:
     def resume(self) -> bool:
         """Pick a checkpointed job back up (coordinator restart, or an
         operator retry after an abort that had already committed
-        cutovers). Returns False when there is nothing to resume."""
+        cutovers). Returns False when there is nothing to resume. A
+        revert checkpoint resumes the REVERSE migration: the remaining
+        still-committed shards stream back until placement is fully
+        restored."""
         path = self._state_path()
         if not path or not os.path.exists(path):
             return False
@@ -983,6 +1018,14 @@ class RebalanceCoordinator:
             self.server.logger.error(
                 "rebalance: unreadable checkpoint %s: %s", path, e)
             return False
+        if state.get("revert"):
+            self.server.logger.info(
+                "rebalance: resuming REVERT job %s (%d shards still on "
+                "target owners)", state.get("jobID"), len(committed))
+            self.begin_revert(new_nodes, committed,
+                              job_id=state.get("jobID"),
+                              attempt=int(state.get("attempt", 0)) + 1)
+            return True
         self.server.logger.info(
             "rebalance: resuming job %s (%d shards already committed)",
             state.get("jobID"), len(committed))
@@ -990,6 +1033,128 @@ class RebalanceCoordinator:
                    job_id=state.get("jobID"),
                    attempt=int(state.get("attempt", 0)) + 1)
         return True
+
+    def begin_revert(self, target_nodes: List[Node],
+                     still_committed: Set[Tuple[str, int]],
+                     job_id: Optional[str] = None, attempt: int = 0) -> None:
+        """Reverse migration (docs/rebalance.md): an aborted job left
+        `still_committed` shards routed to the TARGET owners. Stream
+        each one's fragments from the target owners back to the prior
+        owners (the same freeze -> final-drain -> seal machinery as the
+        forward direction, run against the inverted placement diff),
+        flip its routing back per shard, and finish by dropping the
+        overrides entirely — zero mixed routing, zero _moved freezes,
+        byte-identical fragments on the restored owners."""
+        from .resize import fragment_sources
+        from .node import Cluster
+
+        server = self.server
+        cluster = server.cluster
+        remaining = {(i, int(s)) for i, s in still_committed}
+        with self._lock:
+            if self.job is not None:
+                raise PilosaError("a rebalance job is already running")
+            prior = Cluster(
+                node=cluster.node, nodes=list(cluster.nodes),
+                replica_n=cluster.replica_n, partition_n=cluster.partition_n,
+                hasher=cluster.hasher,
+            )
+            target = Cluster(
+                node=cluster.node,
+                nodes=sorted(target_nodes, key=lambda n: n.id),
+                replica_n=cluster.replica_n, partition_n=cluster.partition_n,
+                hasher=cluster.hasher,
+            )
+            schema = server.holder.schema()
+            max_shards = {
+                name: idx.max_shard()
+                for name, idx in server.holder.indexes.items()
+            }
+            # The inverted placement diff: who gains each fragment going
+            # target -> prior, restricted to the shards actually cut
+            # over. A never-moved shard's fragments never left the prior
+            # owners, so streaming them would pull from target owners
+            # that may hold no data at all.
+            sources = fragment_sources(target, prior, schema, max_shards)
+            moves: Dict[str, List[dict]] = {}
+            for node_id, frag_list in sources.items():
+                per_shard: Dict[Tuple[str, int], dict] = {}
+                for f in frag_list:
+                    key = (f["index"], int(f["shard"]))
+                    if key not in remaining:
+                        continue
+                    entry = per_shard.setdefault(key, {
+                        "index": f["index"], "shard": int(f["shard"]),
+                        "fragments": [],
+                    })
+                    entry["fragments"].append(
+                        {"field": f["field"], "view": f["view"],
+                         "sourceNodeID": f["sourceNodeID"]})
+                if per_shard:
+                    moves[node_id] = [per_shard[k] for k in sorted(per_shard)]
+            job = RebalanceJob(
+                job_id or uuid.uuid4().hex[:8], target.nodes, moves,
+                attempt=attempt, revert=True)
+            job.revert_remaining = set(remaining)
+            self.job = job
+
+        self._stats.add("jobs_revert_started")
+        # A restarted coordinator rebuilt its membership from the
+        # persisted PRIOR topology with no overrides: reinstall the
+        # mixed-routing state the abort left (next=target, migrated=
+        # remaining) so per-shard reverse flips have something to flip.
+        if cluster.next_nodes is None:
+            cluster.begin_rebalance(job.new_nodes, committed=remaining)
+        self._persist(job)
+        participants = set(job.moves)
+        for srcs in job.sources.values():
+            participants |= srcs
+        participants = sorted(participants)
+        begin_msg = {
+            "type": "rebalance-begin", "jobID": job.id,
+            "attempt": job.attempt, "revert": True,
+            "nodes": [n.to_dict() for n in cluster.nodes],
+            "newNodes": [n.to_dict() for n in job.new_nodes],
+            "participants": participants,
+            "committed": sorted([list(k) for k in remaining]),
+            "epoch": cluster.routing_epoch,
+        }
+        self._broadcast_all(begin_msg)
+        # Shards whose owner sets don't differ between the two
+        # placements (possible at small replica overlaps) need no
+        # stream: their data never moved, so routing flips back now.
+        for key in sorted(remaining - set(job.gainers)):
+            cluster.revert_cutover(key[0], key[1])
+            with job.lock:
+                job.revert_remaining.discard(key)
+            self._stats.add("shards_reverted")
+            self._persist(job)
+            self._broadcast_all({
+                "type": "cutover-revert", "jobID": job.id,
+                "index": key[0], "shard": key[1],
+                "epoch": cluster.routing_epoch,
+            })
+        node_uris = {n.id: n.uri for n in cluster.nodes}
+        node_uris.update({n.id: n.uri for n in job.new_nodes})
+        for node_id, entries in job.moves.items():
+            msg = {
+                "type": "rebalance-instruction", "jobID": job.id,
+                "attempt": job.attempt,
+                "coordinatorID": cluster.node.id,
+                "coordinatorURI": cluster.node.uri,
+                "schema": schema,
+                "maxShards": max_shards,
+                "nodeURIs": node_uris,
+                "moves": entries,
+            }
+            try:
+                self._send(node_id, msg)
+            except PilosaError as e:
+                self.abort(f"cannot deliver revert instruction to "
+                           f"{node_id}: {e}")
+                return
+        if not job.pending_shards():
+            self._complete_revert(job)
 
     # ----------------------------------------------------------- progress
 
@@ -1028,6 +1193,7 @@ class RebalanceCoordinator:
                 self._send(node_id, {
                     "type": "rebalance-finalize", "jobID": job.id,
                     "index": key[0], "shard": key[1],
+                    "revert": job.revert,
                 })
             except PilosaError as e:
                 self.abort(f"cannot deliver finalize for {key} to "
@@ -1046,8 +1212,23 @@ class RebalanceCoordinator:
             if key in job.committed:
                 return
             job.committed.add(key)
+            job.revert_remaining.discard(key)
             all_done = not job.pending_shards()
         cluster = self.server.cluster
+        if job.revert:
+            # Reverse migration: the shard's data is back on its prior
+            # owners — flip routing BACK and tell everyone.
+            cluster.revert_cutover(key[0], key[1])
+            self._stats.add("shards_reverted")
+            self._persist(job)
+            self._broadcast_all({
+                "type": "cutover-revert", "jobID": job.id,
+                "index": key[0], "shard": key[1],
+                "epoch": cluster.routing_epoch,
+            })
+            if all_done:
+                self._complete_revert(job)
+            return
         cluster.apply_cutover(key[0], key[1])
         # Close the write-pause sample when the COORDINATOR was the
         # shard's source: the broadcast below skips self, so the
@@ -1091,6 +1272,7 @@ class RebalanceCoordinator:
                 return
             self.job = None
             job.finalized = True
+            self.revert_on_abort = False
         server = self.server
         cluster = server.cluster
         old_nodes = list(cluster.nodes)
@@ -1132,24 +1314,85 @@ class RebalanceCoordinator:
         server.logger.info("rebalance job %s complete: %d nodes, epoch %d",
                            job.id, len(cluster.nodes), cluster.routing_epoch)
 
-    def abort(self, reason: str) -> None:
+    def _complete_revert(self, job: RebalanceJob) -> None:
+        """Reverse migration finished: every committed shard streamed
+        back and flipped. Drop the overrides entirely (full revert to
+        the prior topology), thaw everything, clear the checkpoint, and
+        broadcast the same rebalance-abort-with-empty-committed the
+        followers' full-revert path already handles."""
+        with self._lock:
+            if self.job is not job:
+                return
+            self.job = None
+            job.finalized = True
+            self.revert_on_abort = False
+        server = self.server
+        cluster = server.cluster
+        server.rebalance_receiver.handle_abort(
+            {"jobID": job.id, "committed": []})
+        server.migration_source.abort_all()
+        server.migration_source.unfreeze(keep=())
+        cluster.abort_rebalance(committed=set())
+        cluster.health.clear_copy_grace()
+        self._clear_state()
+        self._stats.add("jobs_reverted")
+        self._broadcast_all({
+            "type": "rebalance-abort", "jobID": job.id,
+            "attempt": job.attempt,
+            "reason": "reverse migration complete",
+            "committed": [],
+        }, extra_nodes=job.new_nodes)
+        # Members drop fragments for shards they no longer own on the
+        # restored topology (the forward copies on surviving members);
+        # epoch-guarded like every post-routing-change GC.
+        from .topology import HolderCleaner
+
+        removed = HolderCleaner(server).clean_holder()
+        if removed:
+            server.logger.info(
+                "revert %s: holder cleaner removed %d fragments",
+                job.id, len(removed))
+        server.logger.info(
+            "rebalance job %s fully reverted: placement restored, epoch %d",
+            job.id, cluster.routing_epoch)
+
+    def abort(self, reason: str, revert: bool = False) -> None:
+        """Abort the running job. With revert=False (operator default),
+        committed cutovers keep their mixed routing and resume()
+        finishes the job FORWARD. With revert=True (the autoscaler's
+        contract: an aborted scale job must leave no trace), a reverse
+        migration starts immediately after the abort settles, streaming
+        committed shards back until the prior placement is fully
+        restored."""
         with self._lock:
             job, self.job = self.job, None
+            # An autoscale job's abort always reverts (no operator to
+            # resume it forward); consult the flag under the lock so a
+            # racing begin() can't re-arm it mid-abort.
+            revert = revert or self.revert_on_abort
         if job is None:
             return
         server = self.server
         server.logger.error("rebalance job %s aborted: %s", job.id, reason)
         self._stats.add("jobs_aborted")
-        committed = sorted([list(k) for k in job.committed])
+        if job.revert:
+            # Aborting a revert job: per-shard reverse flips already
+            # applied stand; what's left stays on the target owners
+            # (mixed routing) and the revert checkpoint lets resume()
+            # finish the restore.
+            with job.lock:
+                still = set(job.revert_remaining)
+        else:
+            still = set(job.committed)
+        committed = sorted([list(k) for k in still])
         # The coordinator never receives its own broadcast: apply the
         # local side of the abort here too (it may be a source with
         # frozen fragments, and a receiver with parked streams).
         server.rebalance_receiver.handle_abort(
             {"jobID": job.id, "committed": committed})
         server.migration_source.abort_all()
-        server.migration_source.unfreeze(keep=job.committed)
-        reverted = server.cluster.abort_rebalance(
-            committed={tuple(k) for k in job.committed})
+        server.migration_source.unfreeze(keep=still)
+        reverted = server.cluster.abort_rebalance(committed=still)
         server.cluster.health.clear_copy_grace()
         if reverted:
             job.finalized = True
@@ -1157,17 +1400,25 @@ class RebalanceCoordinator:
         else:
             # Cutovers already committed cannot be un-committed without a
             # reverse migration: keep the mixed routing AND the checkpoint
-            # so resume() can finish the job forward.
+            # so resume() can finish the job (forward, or by completing
+            # the revert).
             self._persist(job)
             server.logger.error(
                 "rebalance job %s aborted after %d cutovers: mixed routing "
-                "kept; resume() finishes the job forward",
-                job.id, len(job.committed))
+                "kept; resume() finishes the job %s",
+                job.id, len(still),
+                "revert" if job.revert or revert else "forward")
         self._broadcast_all({
             "type": "rebalance-abort", "jobID": job.id,
             "attempt": job.attempt, "reason": reason,
             "committed": committed,
         }, extra_nodes=job.new_nodes)
+        if revert and not reverted and not job.revert:
+            # Full-restore contract: stream every committed shard back.
+            # Runs AFTER the abort broadcast so every node has settled
+            # into the mixed-routing state the reverse job starts from.
+            self.begin_revert(job.new_nodes, still,
+                              attempt=job.attempt + 1)
 
     # ------------------------------------------------------------ helpers
 
@@ -1185,6 +1436,13 @@ class RebalanceCoordinator:
                     "newNodes": [n.to_dict() for n in job.new_nodes],
                     "committed": sorted([list(k) for k in job.committed]),
                 }
+                if job.revert:
+                    # A revert checkpoint records what still needs to
+                    # flip BACK (shrinking), not what flipped forward:
+                    # resume() re-reverts exactly the remainder.
+                    state["revert"] = True
+                    state["committed"] = sorted(
+                        [list(k) for k in job.revert_remaining])
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(state, f)

@@ -76,6 +76,15 @@ class GossipConfig:
 # See docs/scheduler.md for how the knobs interact.
 from .sched import SchedulerConfig as SchedConfig  # noqa: E402
 
+# And for [qos]: the per-tenant budget knobs live with the ledger the
+# scheduler consults (sched/qos.py, jax-free). See docs/scheduler.md.
+from .sched import QosConfig  # noqa: E402
+
+# And for [autoscale]: the load-driven membership-control knobs live
+# with the controller (cluster/autoscale.py, jax-free). See
+# docs/rebalance.md.
+from .cluster.autoscale import AutoscaleConfig  # noqa: E402
+
 # Same pattern for [storage]: the durability-policy dataclass lives with
 # the storage layer it governs. See docs/durability.md.
 from .storage import StorageConfig  # noqa: E402
@@ -160,6 +169,8 @@ class Config:
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     gossip: GossipConfig = field(default_factory=GossipConfig)
     scheduler: SchedConfig = field(default_factory=SchedConfig)
+    qos: QosConfig = field(default_factory=QosConfig)
+    autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
@@ -309,10 +320,34 @@ class Config:
         self.scheduler.default_deadline = s.get(
             "default-deadline", self.scheduler.default_deadline)
         self.scheduler.retry_after = s.get("retry-after", self.scheduler.retry_after)
+        self.scheduler.retry_jitter = s.get(
+            "retry-jitter", self.scheduler.retry_jitter)
         self.scheduler.batch_window = s.get("batch-window", self.scheduler.batch_window)
         self.scheduler.batch_window_max = s.get(
             "batch-window-max", self.scheduler.batch_window_max)
         self.scheduler.batch_max = s.get("batch-max", self.scheduler.batch_max)
+        q = d.get("qos", {})
+        self.qos.rate = q.get("rate", self.qos.rate)
+        self.qos.burst = q.get("burst", self.qos.burst)
+        self.qos.default_tenant_share = q.get(
+            "default-tenant-share", self.qos.default_tenant_share)
+        self.qos.interactive_cap = q.get(
+            "interactive-cap", self.qos.interactive_cap)
+        self.qos.estimate_ms = q.get("estimate-ms", self.qos.estimate_ms)
+        au = d.get("autoscale", {})
+        self.autoscale.interval = au.get("interval", self.autoscale.interval)
+        self.autoscale.window = au.get("window", self.autoscale.window)
+        self.autoscale.scale_out_qps = au.get(
+            "scale-out-qps", self.autoscale.scale_out_qps)
+        self.autoscale.scale_in_qps = au.get(
+            "scale-in-qps", self.autoscale.scale_in_qps)
+        self.autoscale.p99_ms = au.get("p99-ms", self.autoscale.p99_ms)
+        self.autoscale.cooldown = au.get("cooldown", self.autoscale.cooldown)
+        self.autoscale.min_nodes = au.get(
+            "min-nodes", self.autoscale.min_nodes)
+        self.autoscale.max_nodes = au.get(
+            "max-nodes", self.autoscale.max_nodes)
+        self.autoscale.standby = au.get("standby", self.autoscale.standby)
         st = d.get("storage", {})
         self.storage.fsync = st.get("fsync", self.storage.fsync)
         self.storage.fsync_batch_ops = st.get(
@@ -515,6 +550,7 @@ class Config:
             ("batch_concurrency", "SCHED_BATCH_CONCURRENCY", int),
             ("default_deadline", "SCHED_DEFAULT_DEADLINE", float),
             ("retry_after", "SCHED_RETRY_AFTER", float),
+            ("retry_jitter", "SCHED_RETRY_JITTER", float),
             ("batch_window", "SCHED_BATCH_WINDOW", float),
             ("batch_window_max", "SCHED_BATCH_WINDOW_MAX", float),
             ("batch_max", "SCHED_BATCH_MAX", int),
@@ -522,6 +558,30 @@ class Config:
             v = env(name, cast)
             if v is not None:
                 setattr(self.scheduler, attr, v)
+        for attr, name, cast in [
+            ("rate", "QOS_RATE", float),
+            ("burst", "QOS_BURST", float),
+            ("default_tenant_share", "QOS_DEFAULT_TENANT_SHARE", float),
+            ("interactive_cap", "QOS_INTERACTIVE_CAP", float),
+            ("estimate_ms", "QOS_ESTIMATE_MS", float),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.qos, attr, v)
+        for attr, name, cast in [
+            ("interval", "AUTOSCALE_INTERVAL", float),
+            ("window", "AUTOSCALE_WINDOW", int),
+            ("scale_out_qps", "AUTOSCALE_SCALE_OUT_QPS", float),
+            ("scale_in_qps", "AUTOSCALE_SCALE_IN_QPS", float),
+            ("p99_ms", "AUTOSCALE_P99_MS", float),
+            ("cooldown", "AUTOSCALE_COOLDOWN", float),
+            ("min_nodes", "AUTOSCALE_MIN_NODES", int),
+            ("max_nodes", "AUTOSCALE_MAX_NODES", int),
+            ("standby", "AUTOSCALE_STANDBY", str),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.autoscale, attr, v)
         for attr, name, cast in [
             ("fsync", "STORAGE_FSYNC", str),
             ("fsync_batch_ops", "STORAGE_FSYNC_BATCH_OPS", int),
@@ -681,9 +741,24 @@ class Config:
             "sched_batch_concurrency": ("scheduler", "batch_concurrency"),
             "sched_default_deadline": ("scheduler", "default_deadline"),
             "sched_retry_after": ("scheduler", "retry_after"),
+            "sched_retry_jitter": ("scheduler", "retry_jitter"),
             "sched_batch_window": ("scheduler", "batch_window"),
             "sched_batch_window_max": ("scheduler", "batch_window_max"),
             "sched_batch_max": ("scheduler", "batch_max"),
+            "qos_rate": ("qos", "rate"),
+            "qos_burst": ("qos", "burst"),
+            "qos_default_tenant_share": ("qos", "default_tenant_share"),
+            "qos_interactive_cap": ("qos", "interactive_cap"),
+            "qos_estimate_ms": ("qos", "estimate_ms"),
+            "autoscale_interval": ("autoscale", "interval"),
+            "autoscale_window": ("autoscale", "window"),
+            "autoscale_scale_out_qps": ("autoscale", "scale_out_qps"),
+            "autoscale_scale_in_qps": ("autoscale", "scale_in_qps"),
+            "autoscale_p99_ms": ("autoscale", "p99_ms"),
+            "autoscale_cooldown": ("autoscale", "cooldown"),
+            "autoscale_min_nodes": ("autoscale", "min_nodes"),
+            "autoscale_max_nodes": ("autoscale", "max_nodes"),
+            "autoscale_standby": ("autoscale", "standby"),
             "storage_fsync": ("storage", "fsync"),
             "storage_fsync_batch_ops": ("storage", "fsync_batch_ops"),
             "storage_snapshot_ratio": ("storage", "snapshot_ratio"),
@@ -827,9 +902,28 @@ class Config:
             f"batch-concurrency = {self.scheduler.batch_concurrency}",
             f"default-deadline = {self.scheduler.default_deadline}",
             f"retry-after = {self.scheduler.retry_after}",
+            f"retry-jitter = {self.scheduler.retry_jitter}",
             f"batch-window = {self.scheduler.batch_window}",
             f"batch-window-max = {self.scheduler.batch_window_max}",
             f"batch-max = {self.scheduler.batch_max}",
+            "",
+            "[qos]",
+            f"rate = {self.qos.rate}",
+            f"burst = {self.qos.burst}",
+            f"default-tenant-share = {self.qos.default_tenant_share}",
+            f"interactive-cap = {self.qos.interactive_cap}",
+            f"estimate-ms = {self.qos.estimate_ms}",
+            "",
+            "[autoscale]",
+            f"interval = {self.autoscale.interval}",
+            f"window = {self.autoscale.window}",
+            f"scale-out-qps = {self.autoscale.scale_out_qps}",
+            f"scale-in-qps = {self.autoscale.scale_in_qps}",
+            f"p99-ms = {self.autoscale.p99_ms}",
+            f"cooldown = {self.autoscale.cooldown}",
+            f"min-nodes = {self.autoscale.min_nodes}",
+            f"max-nodes = {self.autoscale.max_nodes}",
+            f"standby = {fmt(self.autoscale.standby)}",
             "",
             "[storage]",
             f"fsync = {fmt(self.storage.fsync)}",
@@ -924,6 +1018,8 @@ class Config:
             coordinator_failover_probes=self.gossip.failover_probes,
             internal_key_path=self.gossip.key or None,
             scheduler_config=self.scheduler,
+            qos_config=self.qos.validate(),
+            autoscale_config=self.autoscale.validate(),
             storage_config=self.storage.validate(),
             ingest_config=self.ingest.validate(),
             engine_config=self.engine,

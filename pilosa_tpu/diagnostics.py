@@ -85,6 +85,31 @@ class DiagnosticsCollector:
             snap = batcher.snapshot()
             info["schedBatchLaunches"] = snap.get("launches", 0)
             info["schedBatchCoalesced"] = snap.get("coalesced", 0)
+        # Multi-tenant QoS shape (docs/scheduler.md "Tenant budgets"):
+        # whether budgets are on, how many tenants the ledger tracks, and
+        # the charge/shed/defer totals — whether multi-tenant isolation
+        # is actively working (per-tenant detail stays in /debug/vars).
+        qos = getattr(self.server, "qos", None)
+        if qos is not None:
+            snap = qos.snapshot()
+            info["qosEnabled"] = snap.get("enabled", False)
+            info["qosTenants"] = snap.get("tenants", 0)
+            info["qosCharged"] = snap.get("charged", 0)
+            info["qosShedBatch"] = snap.get("shed_batch", 0)
+            info["qosShedInteractive"] = snap.get("shed_interactive", 0)
+            info["qosDeferred"] = snap.get("deferred", 0)
+        # Autoscaler shape (docs/rebalance.md "Autoscaling"): how often
+        # the controller acted and what it last decided — whether the
+        # cluster is sizing itself (window/sample detail stays in
+        # /debug/vars).
+        autoscaler = getattr(self.server, "autoscaler", None)
+        if autoscaler is not None:
+            snap = autoscaler.snapshot()
+            info["autoscaleSteps"] = snap.get("steps", 0)
+            info["autoscaleScaleOut"] = snap.get("scale_out", 0)
+            info["autoscaleScaleIn"] = snap.get("scale_in", 0)
+            info["autoscaleLastDecision"] = snap.get("last_decision")
+            info["autoscaleAddedNodes"] = len(snap.get("added_nodes", []))
         # Query-plan compiler shape (docs/query-compiler.md): cache hits
         # dwarfing builds means the per-query canonical lowering is being
         # reused across dispatch sites; reorders/flattens nonzero means

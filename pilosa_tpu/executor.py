@@ -108,6 +108,11 @@ class ExecOptions:
     # No-op on a leader or non-geo node: local state is the source of
     # truth there, never stale (docs/geo-replication.md).
     max_staleness: Optional[float] = None
+    # QoS budget identity (X-Pilosa-Tenant header, default: the index
+    # name). Tags the query's trace so the per-tenant ledger
+    # (sched/qos.py) can attribute the measured device cost, and rides
+    # forwarded requests' headers so data-node spans carry it too.
+    tenant: Optional[str] = None
 
 
 class _NoDeviceHealth:
@@ -654,6 +659,11 @@ class Executor:
                     # test clients without the parameter keep working.
                     opt.deadline.check("remote fan-out")
                     kw["deadline"] = opt.deadline.remaining()
+                if opt.tenant is not None:
+                    # Tenant identity rides the hop (trace attribution on
+                    # the peer); kwarg only when set so duck-typed test
+                    # clients without the parameter keep working.
+                    kw["tenant"] = opt.tenant
                 try:
                     v = self._remote_dispatch(node, index, c, node_shards, kw)
                 except ClientError as e:

@@ -194,7 +194,7 @@ class Trace:
 
     __slots__ = ("trace_id", "index", "pql", "adopted", "start_wall",
                  "_start", "_clock", "spans", "duration_ms", "status",
-                 "finished", "spans_dropped", "_lock")
+                 "finished", "spans_dropped", "tags", "_lock")
 
     def __init__(self, trace_id: str, index: str = "", pql: str = "",
                  adopted: bool = False, clock=time.monotonic):
@@ -210,12 +210,23 @@ class Trace:
         self.status = "ok"
         self.finished = False
         self.spans_dropped = 0
+        self.tags: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
 
     # ----------------------------------------------------------- recording
 
     def span(self, name: str, **tags) -> Span:
         return Span(self, name, tags or None)
+
+    def tag(self, **kw) -> None:
+        """Trace-level tags (e.g. the QoS tenant): request attributes
+        that belong to the whole query, not one stage."""
+        with self._lock:
+            if self.finished:
+                return
+            if self.tags is None:
+                self.tags = {}
+            self.tags.update(kw)
 
     def record(self, name: str, dur_ms: float, **tags) -> None:
         """Append a pre-measured span ending now."""
@@ -253,6 +264,9 @@ class Trace:
             "status": self.status,
             "spans": spans,
         }
+        with self._lock:
+            if self.tags:
+                out["tags"] = dict(self.tags)
         if self.spans_dropped:
             out["spans_dropped"] = self.spans_dropped
         return out

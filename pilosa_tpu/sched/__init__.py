@@ -13,7 +13,10 @@ lifecycle (admit -> wait -> coalesce -> execute -> split):
                   (interactive vs. import traffic) and 429 load shedding;
   - batcher.py    micro-batcher coalescing compatible count dispatches into
                   one fused engine launch within an adaptive ~0.5-2 ms
-                  window, splitting results back per caller.
+                  window, splitting results back per caller;
+  - qos.py        per-tenant token buckets charged the query's MEASURED
+                  cost from its trace spans, with SLO-classed shedding
+                  (batch sheds first, interactive past a hard cap).
 """
 
 from .deadline import Deadline, DeadlineExceededError
@@ -25,6 +28,7 @@ from .scheduler import (
     SchedulerConfig,
 )
 from .batcher import MicroBatcher
+from .qos import QosConfig, TenantBudgetError, TenantLedger
 
 __all__ = [
     "CLASS_BATCH",
@@ -32,7 +36,10 @@ __all__ = [
     "Deadline",
     "DeadlineExceededError",
     "MicroBatcher",
+    "QosConfig",
     "QueryScheduler",
     "QueueFullError",
     "SchedulerConfig",
+    "TenantBudgetError",
+    "TenantLedger",
 ]

@@ -20,6 +20,7 @@ from ..core.index import IndexOptions
 from ..core.row import Row
 from ..errors import PilosaError, QueryError
 from ..executor import ExecOptions, Executor, ValCount
+from ..obs import current as obs_current
 from ..core.cache import Pair
 
 
@@ -106,14 +107,21 @@ class API:
         epoch: Optional[int] = None,
         at_position: Optional[int] = None,
         max_staleness: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> List[Any]:
         """Execute PQL under the query scheduler's lifecycle: admit (429
         when the queue is full) -> wait (bounded by `deadline`) ->
         execute, with the deadline riding ExecOptions so the executor
         aborts expired work before the next device dispatch. `deadline`
         is a sched.Deadline (or None); `traffic_class` defaults to
-        interactive."""
+        interactive. `tenant` (the X-Pilosa-Tenant header, defaulting to
+        the index name) is the QoS budget identity — see sched/qos.py."""
         self._validate("query")
+        # Tenant identity defaults to the index name: single-tenant
+        # deployments get per-index budgets for free, multi-tenant ones
+        # send X-Pilosa-Tenant. Tagged onto the trace so the QoS ledger
+        # and trace consumers can attribute the measured cost.
+        tenant = tenant or index
         opt = ExecOptions(
             remote=remote,
             column_attrs=column_attrs,
@@ -123,7 +131,11 @@ class API:
             epoch=epoch,
             at_position=at_position,
             max_staleness=max_staleness,
+            tenant=tenant,
         )
+        t = obs_current()
+        if t is not None:
+            t.tag(tenant=tenant)
         sched = getattr(self.server, "scheduler", None)
         if sched is None:
             return self.executor.execute(index, query, shards=shards, opt=opt)
@@ -147,7 +159,8 @@ class API:
                 with sched.track_remote():
                     return self.executor.execute(
                         index, query, shards=shards, opt=opt)
-            with sched.admit(traffic_class or CLASS_INTERACTIVE, deadline):
+            with sched.admit(traffic_class or CLASS_INTERACTIVE, deadline,
+                             tenant=tenant):
                 return self.executor.execute(index, query, shards=shards, opt=opt)
         except DeadlineExceededError as e:
             # Expiries detected downstream (executor map/reduce, remote

@@ -251,7 +251,8 @@ class InternalClient:
     def query_node(self, node, index: str, query: str,
                    shards: Optional[Sequence[int]] = None, remote: bool = True,
                    deadline: Optional[float] = None,
-                   epoch: Optional[int] = None, trace=None) -> List[Any]:
+                   epoch: Optional[int] = None, trace=None,
+                   tenant: Optional[str] = None) -> List[Any]:
         """Execute PQL on a peer restricted to its shards (http/client.go
         QueryNode). `deadline` is the coordinator's REMAINING budget in
         seconds; it rides X-Pilosa-Deadline so the peer aborts its own
@@ -277,6 +278,11 @@ class InternalClient:
             extra["X-Pilosa-Epoch"] = str(int(epoch))
         if trace is not None:
             extra["X-Pilosa-Trace"] = trace.wire_id()
+        if tenant is not None:
+            # QoS identity rides the hop so the data node's trace spans
+            # carry the same tenant tag (budget charging itself stays on
+            # the coordinator: forwarded sub-queries bypass admission).
+            extra["X-Pilosa-Tenant"] = tenant
         extra = extra or None
         raw, resp_headers = self._request(
             "POST", url, body, accept=wire.CONTENT_TYPE,

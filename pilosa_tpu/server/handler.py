@@ -187,13 +187,20 @@ class Handler:
                 if isinstance(e, QueueFullError):
                     # Load shed: tell the client WHEN to come back instead
                     # of letting it hammer a saturated queue (Retry-After
-                    # is integer seconds per RFC 9110).
+                    # is integer seconds per RFC 9110). A tenant-budget
+                    # shed (TenantBudgetError) echoes the tenant so a
+                    # multiplexing client can throttle ONE tenant's
+                    # traffic instead of backing everything off.
                     import math
 
                     retry = str(max(1, math.ceil(e.retry_after)))
+                    hdrs = {"Retry-After": retry}
+                    tenant = getattr(e, "tenant", None)
+                    if tenant is not None:
+                        hdrs["X-Pilosa-Tenant"] = str(tenant)
                     return (429, "application/json",
                             json.dumps({"error": str(e)}).encode(),
-                            {"Retry-After": retry})
+                            hdrs)
                 if isinstance(e, DeadlineExceededError):
                     # The budget ran out server-side; 503 (not 400) so
                     # clients/balancers treat it as overload, not a bad
@@ -406,7 +413,12 @@ class Handler:
         else:
             from ..sched import CLASS_BATCH
 
-            with scheduler.admit(CLASS_BATCH):
+            # Imports charge the tenant's budget too (X-Pilosa-Tenant,
+            # default: index) — bulk-load device time is exactly the
+            # noisy-tenant cost the ledger exists to bound. Batch class
+            # sheds FIRST when the bucket runs dry (docs/scheduler.md).
+            tenant = (headers or {}).get("x-pilosa-tenant") or index
+            with scheduler.admit(CLASS_BATCH, tenant=tenant):
                 run()
         return {}
 
@@ -459,6 +471,10 @@ class Handler:
             if max_staleness < 0:
                 raise PilosaError(
                     f"invalid max-staleness value: {raw_stale!r}")
+        # QoS tenant identity (docs/scheduler.md): budget charging and
+        # SLO-classed shedding key on this. Defaults (in api.query) to
+        # the index name so single-tenant deployments need no header.
+        tenant = headers.get("x-pilosa-tenant") or None
         remote = query.get("remote", ["false"])[0] == "true"
         column_attrs = query.get("columnAttrs", ["false"])[0] == "true"
         exclude_row_attrs = query.get("excludeRowAttrs", ["false"])[0] == "true"
@@ -511,13 +527,13 @@ class Handler:
             return self._post_query_traced(
                 index, pql, shards, remote, column_attrs, exclude_row_attrs,
                 exclude_columns, deadline, epoch, wants_proto, headers,
-                None, None, at_position, max_staleness)
+                None, None, at_position, max_staleness, tenant)
         token = _obs.activate(trace)
         try:
             return self._post_query_traced(
                 index, pql, shards, remote, column_attrs, exclude_row_attrs,
                 exclude_columns, deadline, epoch, wants_proto, headers,
-                recorder, trace, at_position, max_staleness)
+                recorder, trace, at_position, max_staleness, tenant)
         except BaseException:
             recorder.finish(trace, status="error")
             raise
@@ -528,7 +544,7 @@ class Handler:
     def _post_query_traced(self, index, pql, shards, remote, column_attrs,
                            exclude_row_attrs, exclude_columns, deadline,
                            epoch, wants_proto, headers, recorder, trace,
-                           at_position=None, max_staleness=None):
+                           at_position=None, max_staleness=None, tenant=None):
         if wants_proto:
             from . import proto
             from ..errors import PilosaError
@@ -541,6 +557,7 @@ class Handler:
                     deadline=deadline,
                     at_position=at_position,
                     max_staleness=max_staleness,
+                    tenant=tenant,
                 )
             except PilosaError as e:
                 from ..sched import DeadlineExceededError, QueueFullError
@@ -558,7 +575,8 @@ class Handler:
             results = self.api.query(index, pql, shards=shards, remote=True,
                                      deadline=deadline, epoch=epoch,
                                      at_position=at_position,
-                                     max_staleness=max_staleness)
+                                     max_staleness=max_staleness,
+                                     tenant=tenant)
             from . import wire
 
             extra = {}
@@ -586,7 +604,7 @@ class Handler:
             index, pql, shards=shards, column_attrs=column_attrs,
             exclude_row_attrs=exclude_row_attrs, exclude_columns=exclude_columns,
             deadline=deadline, at_position=at_position,
-            max_staleness=max_staleness,
+            max_staleness=max_staleness, tenant=tenant,
         )
 
     def _column_attr_sets(self, index, results):
@@ -934,6 +952,24 @@ class Handler:
         if batcher is not None:
             out = dict(out)
             out["batcher"] = batcher.snapshot()
+        # Multi-tenant QoS health (docs/scheduler.md "Tenant budgets"):
+        # per-tenant balances/debt/mean cost plus charge/shed/defer
+        # counters — the on-call question during a noisy-neighbor event
+        # is "which tenant is over budget, and is it being shed or just
+        # deferred behind in-budget traffic".
+        qos = getattr(self.api.server, "qos", None)
+        if qos is not None:
+            out = dict(out)
+            out["qos"] = qos.snapshot()
+        # Autoscaler health (docs/rebalance.md "Autoscaling"): the sample
+        # window, last decision, scale/skip counters, and which nodes the
+        # controller added — the on-call question is "why did (or didn't)
+        # the cluster scale, and what does the controller think the load
+        # is".
+        autoscaler = getattr(self.api.server, "autoscaler", None)
+        if autoscaler is not None:
+            out = dict(out)
+            out["autoscale"] = autoscaler.snapshot()
         # Crash-safety health: which fragments are serving degraded
         # (quarantined at open, repair pending), how often queries touched
         # one, and any armed failpoints (nonempty only under fault tests).

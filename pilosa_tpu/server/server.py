@@ -64,6 +64,8 @@ class Server:
         replication_config=None,
         internal_key_path: Optional[str] = None,
         scheduler_config=None,
+        qos_config=None,
+        autoscale_config=None,
         storage_config=None,
         ingest_config=None,
         engine_config=None,
@@ -267,11 +269,19 @@ class Server:
         # and the executor. The batcher pulls the engine LAZILY so
         # constructing a server never opens the device backend.
         from ..sched import (
-            CLASS_INTERACTIVE, MicroBatcher, QueryScheduler, SchedulerConfig,
+            CLASS_INTERACTIVE, MicroBatcher, QosConfig, QueryScheduler,
+            SchedulerConfig, TenantLedger,
         )
 
         sched_cfg = scheduler_config or SchedulerConfig()
-        self.scheduler = QueryScheduler(sched_cfg, stats=self.stats)
+        # Per-tenant QoS ledger ([qos], docs/scheduler.md): trace-charged
+        # token buckets the scheduler consults at admission. Always
+        # constructed — with rate 0 (the default) it is disabled and
+        # admission short-circuits past it.
+        self.qos_config = (qos_config or QosConfig()).validate()
+        self.qos = TenantLedger(self.qos_config)
+        self.scheduler = QueryScheduler(
+            sched_cfg, stats=self.stats, qos=self.qos)
         # Traffic signal for the tier manager's predictive prefetch: the
         # scheduler's per-index query counters tell the prefetcher which
         # indexes are hot RIGHT NOW. Wired before any query can build the
@@ -322,6 +332,16 @@ class Server:
                 ),
             )
             self.executor.geo = self.geo
+        # Trace-driven autoscaler ([autoscale], docs/rebalance.md):
+        # coordinator-only control loop turning sustained load into
+        # rebalance join/leave, with full revert on abort. Always
+        # constructed (jax-free, cheap); the monitor thread only spawns
+        # when interval > 0.
+        from ..cluster.autoscale import AutoscaleConfig, AutoscaleController
+
+        self.autoscale_config = (
+            autoscale_config or AutoscaleConfig()).validate()
+        self.autoscaler = AutoscaleController(self, self.autoscale_config)
         self.handler = Handler(
             self.api, logger=self.logger, allowed_origins=allowed_origins,
             internal_key=self.internal_key,
@@ -493,6 +513,13 @@ class Server:
                         self.cdc_config.standing_interval)
         if self.metric_poll_interval > 0:
             self._spawn(self._monitor_runtime, self.metric_poll_interval)
+        if self.autoscale_config.interval > 0:
+            # Jittered like anti-entropy: a restarted fleet's control
+            # loops must not all sample at the same instants (only the
+            # coordinator acts, but every node runs the timer in case of
+            # failover promotion).
+            self._spawn(self._monitor_autoscale,
+                        self.autoscale_config.interval, jitter=0.1)
         if self.primary_translate_store_url:
             self._spawn(self._monitor_translate_replication, 1.0)
         if self.diagnostics.interval > 0:
@@ -805,6 +832,13 @@ class Server:
         registrations whose index write epoch moved, push only changed
         results to their long-poll waiters."""
         self.cdc.standing.evaluate_once()
+
+    def _monitor_autoscale(self) -> None:
+        """Autoscale control step (cluster/autoscale.py): sample load,
+        decide via hysteresis, act through the coordinator's join/leave
+        path. Single-flight inside step(); non-coordinators sample-and-
+        return so a failover promotion starts from a warm window."""
+        self.autoscaler.step()
 
     def _monitor_hints(self) -> None:
         """Hinted-handoff delivery sweep (cluster/hints.py): replay
@@ -1288,6 +1322,12 @@ class Server:
                 msg["index"], int(msg["shard"]),
                 pause_cap=self.rebalance_config.cutover_pause_max)
             self.cluster.apply_cutover(
+                msg["index"], int(msg["shard"]), epoch=msg.get("epoch"))
+        elif typ == "cutover-revert":
+            # Reverse migration (docs/rebalance.md): one shard's routing
+            # flips BACK to the prior owners — its data has been
+            # streamed back. Idempotent like apply_cutover.
+            self.cluster.revert_cutover(
                 msg["index"], int(msg["shard"]), epoch=msg.get("epoch"))
         elif typ == "rebalance-complete":
             self._handle_rebalance_complete(msg)

@@ -96,7 +96,7 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     assert not detail.get("partial"), detail.get("partial")
     assert parsed["value"] > 0
     stanzas = _registered_stanzas()
-    assert len(stanzas) >= 21  # the registry itself didn't shrink
+    assert len(stanzas) >= 22  # the registry itself didn't shrink
     for name in stanzas:
         stanza = detail.get(name.lower())
         assert isinstance(stanza, dict), f"stanza {name} missing: {stanza}"
@@ -262,6 +262,35 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
         tmp_path)
     assert geo["lag_samples"] > 0, geo
     assert geo["lag_p99_ms"] < 5000, geo
+    # The MULTITENANT stanza is the QoS/autoscale acceptance metric
+    # (docs/scheduler.md "Tenancy", docs/rebalance.md "Autoscaler"):
+    # the noisy tenant must be shed with the typed 429 (per-tenant
+    # Retry-After + X-Pilosa-Tenant header) while the quiet tenant sees
+    # ZERO 429s; sustained load must scale the cluster out with no
+    # operator action (membership + checkpoint prove it); and the
+    # seed-pinned chaos leg — an abort mid-migration under the armed
+    # revert contract — must fully restore the prior placement with
+    # ZERO lost acked writes. All correctness gates — never retried.
+    # The quiet-tenant p99 BOUND vs its solo baseline is a timing gate:
+    # ratio-or-absolute (8x solo, floored at 500ms — a solo query at
+    # smoke scale is ~2ms while any concurrency legitimately opens the
+    # micro-batcher's coalescing window, so a pure ratio is
+    # meaningless; an unpoliced flood pushes quiet to multi-second
+    # p99s). One isolation rerun per the TIER-flake precedent.
+    mt = detail["multitenant"]
+    assert mt["isolation"]["typed_429"], mt
+    assert mt["isolation"]["quiet_429"] == 0, mt
+    assert mt["autoscale"]["scaled_out"], mt
+    assert mt["autoscale"]["checkpointed"], mt
+    assert mt["chaos"]["reverted"], mt
+    assert mt["chaos"]["routing_restored"], mt
+    assert mt["chaos"]["lost_acked_writes"] == 0, mt
+    assert mt["chaos"]["write_after_revert"], mt
+    assert mt["multitenant_ok"], mt
+    mt = _retry_ratio_gate(
+        "MULTITENANT", mt,
+        lambda m: m["isolation"]["quiet_p99_bounded"], tmp_path)
+    assert mt["isolation"]["quiet_p99_bounded"], mt
 
     # BENCH_OUT got the same line atomically.
     out_path = tmp_path / "bench_out.json"

@@ -405,9 +405,12 @@ def test_instrumented_smoke_chaos_tier_rebalance(tmp_path):
     migration streams, the device-fault ladder's host-execution +
     breaker paths, the hinted-handoff append/deliver machinery under
     quorum-write replica flaps, the CDC change-log append/compact/
-    long-poll paths nested inside the fragment mutex, and the geo
+    long-poll paths nested inside the fragment mutex, the geo
     fencing chaos leg — concurrent writers against both clusters while
-    promote/fence/demote walk the manager and tailer locks) run fully
+    promote/fence/demote walk the manager and tailer locks — and the
+    multi-tenant autoscale chaos leg, where the abort-with-revert path
+    walks the coordinator, scheduler, and QoS ledger locks while
+    migration streams are mid-flight) run fully
     instrumented and must produce zero lock-order cycles and zero
     blocking-under-lock findings — the runtime half of the acceptance
     bar in docs/static-analysis.md."""
@@ -415,7 +418,8 @@ def test_instrumented_smoke_chaos_tier_rebalance(tmp_path):
         ["tests/test_chaos.py", "tests/test_tier.py",
          "tests/test_rebalance.py", "tests/test_device_faults.py",
          "tests/test_replication.py", "tests/test_cdc.py",
-         "tests/test_geo.py::test_geo_chaos_fencing_no_shared_epoch"],
+         "tests/test_geo.py::test_geo_chaos_fencing_no_shared_epoch",
+         "tests/test_autoscale.py::test_abort_mid_migration_fully_reverts"],
         tmp_path / "lockcheck.json", timeout=600,
         # Seeded schedule perturbation (tiny randomized yields at every
         # lock-acquire boundary): the chaos smokes explore interleavings

@@ -130,7 +130,10 @@ def test_admission_reject_when_queue_full():
     with pytest.raises(QueueFullError) as ei:
         with sched.admit(CLASS_INTERACTIVE):
             pass  # pragma: no cover - shed before entry
-    assert ei.value.retry_after == 7.0
+    # Retry-After is DERIVED: base x (1 + queue fullness) with +/-20%
+    # jitter — full queue here, so in [7*2*0.8, 7*2*1.2], never the
+    # fixed base (shed clients must not retry in lockstep).
+    assert 7.0 * 2 * 0.8 <= ei.value.retry_after <= 7.0 * 2 * 1.2
     assert sched.counters["shed"] == 1
     hold.set()
     t1.join(timeout=5)
@@ -480,7 +483,9 @@ def test_http_429_with_retry_after_when_full(server):
         # Slot busy, queue disabled -> immediate shed.
         status, headers, body = _post_query(server.port, "Count(Row(f=1))")
         assert status == 429
-        assert headers.get("Retry-After") == "3"
+        # Derived Retry-After: empty queue (max_queue=0) -> base 3.0 with
+        # +/-20% jitter -> [2.4, 3.6] -> ceil -> "3" or "4".
+        assert headers.get("Retry-After") in ("3", "4")
         assert "queue full" in json.loads(body)["error"]
     finally:
         hold.set()
@@ -621,3 +626,122 @@ def test_microbatch_real_window_coalesces(holder, monkeypatch):
         t.join(timeout=30)
     assert len(set(results)) == 1 and results[0] is not None
     assert engine.counters["count_dispatches"] - before < n
+
+# ------------------------------------------------- fairness + traffic table
+
+
+def _wait_until(cond, timeout=5.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return cond()
+
+
+def test_admit_fifo_no_fast_path_barging():
+    """Release order is strict FIFO: once waiters are parked, a freed
+    slot goes to the HEAD of the queue, and a late arrival parks behind
+    everyone instead of barging through the fast path."""
+    sched = QueryScheduler(SchedulerConfig(
+        max_queue=8, interactive_concurrency=1))
+    hold = threading.Event()
+    entered = threading.Event()
+    order = []
+    threads = []
+
+    def occupant():
+        with sched.admit(CLASS_INTERACTIVE):
+            entered.set()
+            assert hold.wait(timeout=10)
+
+    def client(name):
+        with sched.admit(CLASS_INTERACTIVE):
+            order.append(name)
+
+    t0 = threading.Thread(target=occupant)
+    t0.start()
+    threads.append(t0)
+    assert entered.wait(timeout=10)
+    # Park w0..w2 one at a time so their queue positions are known.
+    for i in range(3):
+        t = threading.Thread(target=client, args=(f"w{i}",))
+        t.start()
+        threads.append(t)
+        assert _wait_until(lambda i=i: sched.queue_depth() == i + 1)
+    # A late arrival while the slot is STILL held and waiters are parked
+    # must join the tail — the fast path is closed to it.
+    late = threading.Thread(target=client, args=("late",))
+    late.start()
+    threads.append(late)
+    assert _wait_until(lambda: sched.queue_depth() == 4)
+    hold.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert order == ["w0", "w1", "w2", "late"]
+
+
+def test_note_index_recency_eviction_at_bound():
+    """The traffic table holds exactly 1024 indexes and evicts by
+    RECENCY: re-touching an old index saves it; the least recently
+    touched entry goes when a new one arrives at the bound."""
+    sched = QueryScheduler(SchedulerConfig())
+    for i in range(1024):
+        sched.note_index(f"idx-{i}")
+    assert len(sched.index_traffic()) == 1024
+    # Refresh idx-0's recency, then push one more index over the bound:
+    # idx-1 (now the least recently touched) is the victim, not idx-0.
+    sched.note_index("idx-0")
+    sched.note_index("idx-new")
+    t = sched.index_traffic()
+    assert len(t) == 1024
+    assert t["idx-0"] == 2
+    assert t["idx-new"] == 1
+    assert "idx-1" not in t
+    assert "idx-2" in t
+
+
+def test_snapshot_trims_index_traffic_to_top_n():
+    """snapshot() carries only the top-32 busiest indexes (plus the full
+    table size) so /debug/vars stops growing with schema churn, while
+    index_traffic() keeps the complete table for prefetch/autoscale."""
+    sched = QueryScheduler(SchedulerConfig())
+    for i in range(40):
+        for _ in range(i + 1):
+            sched.note_index(f"idx-{i}")
+    snap = sched.snapshot()
+    top = snap["index_traffic"]
+    assert len(top) == sched.SNAPSHOT_TRAFFIC_TOP == 32
+    # The 32 busiest are idx-8..idx-39 (touch counts 9..40).
+    assert set(top) == {f"idx-{i}" for i in range(8, 40)}
+    assert top["idx-39"] == 40
+    assert snap["index_traffic_total"] == 40
+    assert len(sched.index_traffic()) == 40
+
+
+def test_derived_retry_after_scales_with_fullness_and_clamps_jitter():
+    """Retry-After grows with queue fullness and jitters around the
+    base; a percent-spelled jitter knob (20 instead of 0.2) clamps to
+    the fraction 1.0 instead of producing negative waits."""
+    import random as _random
+
+    sched = QueryScheduler(
+        SchedulerConfig(max_queue=4, retry_after=10.0, retry_jitter=0.2),
+        rng=_random.Random(7))
+    with sched._lock:
+        sched._waiting_by[CLASS_BATCH] = 0
+        empty = sched._derived_retry_after(CLASS_BATCH)
+        sched._waiting_by[CLASS_BATCH] = 4
+        full = sched._derived_retry_after(CLASS_BATCH)
+        sched._waiting_by[CLASS_BATCH] = 0
+    assert 10.0 * 0.8 <= empty <= 10.0 * 1.2
+    assert 20.0 * 0.8 <= full <= 20.0 * 1.2
+    # Percent-vs-fraction: jitter=20 clamps to 1.0 -> worst case doubles
+    # the scaled base, never goes negative (floor is 0.05s).
+    wild = QueryScheduler(
+        SchedulerConfig(max_queue=4, retry_after=10.0, retry_jitter=20.0),
+        rng=_random.Random(7))
+    for _ in range(50):
+        with wild._lock:
+            r = wild._derived_retry_after(CLASS_INTERACTIVE)
+        assert 0.05 <= r <= 20.0

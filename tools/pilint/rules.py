@@ -1471,6 +1471,9 @@ R11_SECTIONS: Dict[str, Tuple[str, str, str, str]] = {
     "ObsConfig": ("obs", "obs", "OBS", "docs/observability.md"),
     "CdcConfig": ("cdc", "cdc", "CDC", "docs/cdc.md"),
     "GeoConfig": ("geo", "geo", "GEO", "docs/geo-replication.md"),
+    "QosConfig": ("qos", "qos", "QOS", "docs/scheduler.md"),
+    "AutoscaleConfig": ("autoscale", "autoscale", "AUTOSCALE",
+                        "docs/rebalance.md"),
 }
 CONFIG_FILE = "pilosa_tpu/config.py"
 CLI_FILE = "pilosa_tpu/cli.py"
