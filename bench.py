@@ -3772,6 +3772,295 @@ def bench_multitenant():
     return out
 
 
+# --------------------------------------------- internal transport stanza
+
+
+def bench_transport():
+    """pmux vs HTTP on the internal hop (docs/transport.md "Measured"):
+    a 3-node replica_n=2 cluster where the SAME query_node workload runs
+    twice from the coordinator — once with its client's mux detached
+    (plain keep-alive HTTP) and once over the multiplexed transport —
+    so the only variable is the transport. Reports per-hop p50/p99 and
+    fan-out qps for both legs plus the mux frame/byte counters, then
+    two correctness-shaped legs entirely over mux: a REPLICATION-shaped
+    pass (healthy replicated writes -> peer link dropped, writes keep
+    acking with hints appended -> heal -> hints drain over mux ->
+    replica count converges) and a REBALANCE-shaped pass (migration-
+    stream-style full-shard retrieval whose bytes must be identical on
+    both transports). `mux_vs_http_qps` is the gated fan-out ratio."""
+    import shutil
+    import socket
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu import failpoints
+    from pilosa_tpu.cluster.hash import ModHasher
+    from pilosa_tpu.cluster.health import ResilienceConfig
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.errors import PilosaError
+    from pilosa_tpu.server.client import ClientError, InternalClient
+    from pilosa_tpu.server.mux import TransportConfig
+    from pilosa_tpu.server.server import Server
+
+    n_rows = 2
+    n_shards = 2 if SMOKE else 4
+    per_hop_n = 40 if SMOKE else 400
+    fanout_n = 80 if SMOKE else 800
+    fanout_conc = 4
+    repl_writes = 12 if SMOKE else 100
+
+    mux_off = 2000
+
+    def free_port_pair():
+        for _ in range(64):
+            s = socket.socket()
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+            s.close()
+            if port + mux_off > 65000:
+                continue
+            try:
+                probe = socket.socket()
+                probe.bind(("localhost", port + mux_off))
+                probe.close()
+            except OSError:
+                continue
+            return port
+        raise RuntimeError("no free http+mux port pair")
+
+    tmp = tempfile.mkdtemp(prefix="bench-transport-")
+    ports = [free_port_pair() for _ in range(3)]
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = []
+    out = {"shards": n_shards, "per_hop_n": per_hop_n, "fanout_n": fanout_n}
+    try:
+        for i, port in enumerate(ports):
+            s = Server(
+                data_dir=os.path.join(tmp, f"node{i}"),
+                port=port,
+                cluster_hosts=hosts,
+                replica_n=2,
+                hasher=ModHasher(),
+                cache_flush_interval=0,
+                anti_entropy_interval=0,
+                member_monitor_interval=0,
+                transport_config=TransportConfig(
+                    enabled=True, port_offset=mux_off),
+                resilience_config=ResilienceConfig(
+                    breaker_backoff=0.1, breaker_backoff_max=0.5,
+                ),
+            )
+            s.open()
+            servers.append(s)
+        harness = InternalClient(timeout=10.0)
+        harness.create_index(hosts[0], "tx")
+        harness.create_field(hosts[0], "tx", "f")
+        time.sleep(0.05)
+        for row in range(n_rows):
+            for shard in range(n_shards):
+                harness.query(
+                    hosts[0], "tx",
+                    f"Set({shard * SHARD_WIDTH + row + 1}, f={row})")
+
+        s0 = servers[0]
+        peers = [n for n in s0.cluster.nodes if n.id != s0.node.id]
+        # Shards each peer owns, so the hop is a real data-serving hop.
+        peer_shards = {
+            n.id: [sh for sh in range(n_shards)
+                   if any(o.id == n.id
+                          for o in s0.cluster.shard_nodes("tx", sh))]
+            for n in peers
+        }
+        peers = [n for n in peers if peer_shards[n.id]]
+        assert peers, "placement left the coordinator's peers shardless"
+
+        def one_hop(i):
+            node = peers[i % len(peers)]
+            row = i % n_rows
+            got = s0.client.query_node(
+                node, "tx", f"Count(Row(f={row}))",
+                shards=peer_shards[node.id])
+            assert got[0] == len(peer_shards[node.id])
+
+        def run_leg(n, conc):
+            lat = []
+            lat_mu = threading.Lock()
+            err = 0
+
+            def call(i):
+                q0 = time.perf_counter()
+                one_hop(i)
+                dt = time.perf_counter() - q0
+                with lat_mu:
+                    lat.append(dt)
+
+            t0 = time.perf_counter()
+            if conc == 1:
+                for i in range(n):
+                    try:
+                        call(i)
+                    except (ClientError, PilosaError):
+                        err += 1
+            else:
+                with ThreadPoolExecutor(max_workers=conc) as pool:
+                    futs = [pool.submit(call, i) for i in range(n)]
+                    for f in futs:
+                        try:
+                            f.result()
+                        except (ClientError, PilosaError):
+                            err += 1
+            dt = time.perf_counter() - t0
+            lat.sort()
+            pick = (lambda q: round(
+                lat[min(len(lat) - 1, int(len(lat) * q))] * 1e3, 3
+            )) if lat else (lambda q: None)
+            return {"qps": round(len(lat) / dt, 1) if dt else 0.0,
+                    "p50_ms": pick(0.50), "p99_ms": pick(0.99),
+                    "ok": len(lat), "errors": err}
+
+        # ---- HTTP leg: detach the coordinator's mux so the identical
+        # workload rides the keep-alive HTTP pool.
+        mux = s0.client.mux
+        s0.client.mux = None
+        for i in range(4):
+            one_hop(i)  # warm the HTTP pool
+        out["per_hop_http"] = run_leg(per_hop_n, 1)
+        out["fanout_http"] = run_leg(fanout_n, fanout_conc)
+
+        # ---- mux leg: same workload over the multiplexed transport.
+        s0.client.mux = mux
+        before = s0.transport_stats.snapshot()
+        for i in range(4):
+            one_hop(i)  # dial + handshake outside the timed window
+        out["per_hop_mux"] = run_leg(per_hop_n, 1)
+        out["fanout_mux"] = run_leg(fanout_n, fanout_conc)
+        after = s0.transport_stats.snapshot()
+        out["mux_counters"] = {
+            k: after[k] - before.get(k, 0)
+            for k in ("frames_sent", "frames_received", "bytes_sent",
+                      "bytes_received", "batched_frames", "requests_mux",
+                      "requests_http", "handshake_fallbacks")
+        }
+        http_qps = out["fanout_http"]["qps"] or 1e-9
+        out["mux_vs_http_qps"] = round(out["fanout_mux"]["qps"] / http_qps, 3)
+        p50h, p50m = out["per_hop_http"]["p50_ms"], out["per_hop_mux"]["p50_ms"]
+        if p50h is not None and p50m is not None:
+            out["per_hop_p50_saved_ms"] = round(p50h - p50m, 3)
+
+        # ---- REPLICATION-shaped leg over mux: peer link drops, writes
+        # keep acking with hints; heal; hints DRAIN over mux; the
+        # replica's local count converges to the survivor's. The shard
+        # must be CO-OWNED by the coordinator: only a local apply
+        # captures op payloads for the hint log — a non-owner
+        # coordinator writes marker hints (sync-priority only) whose
+        # repair rides anti-entropy, not hint delivery, and this leg
+        # measures hint delivery over mux.
+        vshard = victim = None
+        for sh in range(n_shards + 16):
+            sowners = s0.cluster.shard_nodes("tx", sh)
+            if any(o.id == s0.node.id for o in sowners):
+                vshard = sh
+                victim = next(
+                    o for o in sowners if o.id != s0.node.id)
+                break
+        assert victim is not None, "placement gave node0 no shard"
+        # Seeded shards carry one pre-existing row-0 bit; a shard past
+        # the seeded range starts empty.
+        vbase = 1 if vshard < n_shards else 0
+        failpoints.seed(11)
+        failpoints.configure(f"client-send@{victim.uri}", "drop")
+        wrote = 0
+        for i in range(repl_writes):
+            col = vshard * SHARD_WIDTH + 1000 + i
+            try:
+                harness.query(hosts[0], "tx", f"Set({col}, f=0)")
+                wrote += 1
+            except (ClientError, PilosaError):
+                pass
+        hinted = sum(
+            s.hints.pending(victim.id) for s in servers
+            if s.node.id != victim.id)
+        failpoints.reset()
+        t0 = time.perf_counter()
+        drained = False
+        deadline = t0 + 30.0
+        while time.perf_counter() < deadline and not drained:
+            for s in servers:
+                s._monitor_members()
+                if s.node.id != victim.id:
+                    s.hints.deliver_once(s.cluster, s.client)
+            drained = all(
+                s.hints.pending(victim.id) == 0 for s in servers
+                if s.node.id != victim.id)
+        out["replication_leg"] = {
+            "writes_acked": wrote,
+            "writes_attempted": repl_writes,
+            "hints_appended": hinted,
+            "hint_drain_s": round(time.perf_counter() - t0, 3),
+            "drained": drained,
+        }
+        # Converged: the victim's OWN copy matches the surviving owner's
+        # (replica agreement) and contains every ACKED write (a write
+        # that timed out at the harness under box load may still have
+        # been partially applied + hinted, so an absolute `1 + wrote`
+        # equality would flag phantom loss — replica agreement is the
+        # durable invariant).
+        survivor = next(
+            o for o in s0.cluster.shard_nodes("tx", vshard)
+            if o.id != victim.id)
+        vc = s0.client.query_node(
+            victim, "tx", "Count(Row(f=0))", shards=[vshard])[0]
+        sc = s0.client.query_node(
+            survivor, "tx", "Count(Row(f=0))", shards=[vshard])[0]
+        out["replication_leg"]["replica_count_ok"] = (
+            vc == sc and vc >= vbase + wrote)
+        total = harness.query(
+            hosts[0], "tx", "Count(Row(f=0))")["results"][0]
+        out["replication_leg"]["total_count_ok"] = (
+            total == (n_shards - vbase) + vc)
+
+        # ---- REBALANCE-shaped leg over mux: migration-stream-style
+        # whole-shard retrieval; bytes must be transport-invariant.
+        t0 = time.perf_counter()
+        mux_bytes = s0.client.retrieve_shard_from_uri(
+            victim.uri, "tx", "f", "standard", vshard)
+        mux_dt = time.perf_counter() - t0
+        s0.client.mux = None
+        http_bytes = s0.client.retrieve_shard_from_uri(
+            victim.uri, "tx", "f", "standard", vshard)
+        s0.client.mux = mux
+        out["rebalance_leg"] = {
+            "shard_bytes": len(mux_bytes),
+            "retrieve_ms": round(mux_dt * 1e3, 2),
+            "bit_exact": mux_bytes == http_bytes and len(mux_bytes) > 0,
+        }
+
+        snap = s0.transport_stats.snapshot()
+        out["transport_ok"] = bool(
+            out["mux_counters"]["requests_mux"] > 0
+            and out["mux_counters"]["handshake_fallbacks"] == 0
+            and out["per_hop_http"]["errors"] == 0
+            and out["per_hop_mux"]["errors"] == 0
+            and out["replication_leg"]["drained"]
+            and out["replication_leg"]["replica_count_ok"]
+            and out["replication_leg"]["total_count_ok"]
+            and out["rebalance_leg"]["bit_exact"]
+        )
+        out["final_counters"] = {
+            k: snap[k] for k in ("requests_mux", "requests_http",
+                                 "batched_frames", "inflight_hwm")}
+    finally:
+        failpoints.reset()
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # Every optional stanza, in run order. THE registry: main() runs exactly
 # these, the FINAL JSON line carries a key per entry (lowercased), and
 # tests/test_bench_smoke.py asserts every name is present — a stanza
@@ -3800,6 +4089,7 @@ STANZAS = (
     ("TIME_RANGE", bench_time_range),
     ("GEO", bench_geo),
     ("MULTITENANT", bench_multitenant),
+    ("TRANSPORT", bench_transport),
 )
 
 

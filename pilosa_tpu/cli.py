@@ -226,6 +226,28 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    type=int,
                    help="consecutive failed leader contacts before a "
                         "probe-driven promotion fires")
+    p.add_argument("--transport-enabled", dest="transport_enabled", type=int,
+                   metavar="{0,1}",
+                   help="1 turns on the pmux internal transport: one "
+                        "persistent multiplexed binary connection per peer "
+                        "pair for node-to-node traffic, with per-peer HTTP "
+                        "fallback (docs/transport.md)")
+    p.add_argument("--transport-port-offset", dest="transport_port_offset",
+                   type=int,
+                   help="mux listener binds on http-port + this offset; "
+                        "every node of a cluster must agree")
+    p.add_argument("--transport-max-frames-inflight",
+                   dest="transport_max_frames_inflight", type=int,
+                   help="concurrent unanswered frames per peer connection; "
+                        "excess requests ride HTTP")
+    p.add_argument("--transport-frame-max-bytes",
+                   dest="transport_frame_max_bytes", type=int,
+                   help="largest mux frame accepted or sent; oversized "
+                        "payloads (e.g. big migration chunks) ride HTTP")
+    p.add_argument("--transport-handshake-timeout",
+                   dest="transport_handshake_timeout", type=float,
+                   help="seconds to wait for the mux version/key handshake "
+                        "before demoting the peer to HTTP")
     p.add_argument("--sched-max-queue", dest="sched_max_queue", type=int,
                    help="bounded admission queue; full requests get 429")
     p.add_argument("--sched-interactive-concurrency",

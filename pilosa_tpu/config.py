@@ -131,6 +131,12 @@ from .cdc import CdcConfig  # noqa: E402
 # subsystem (pilosa_tpu/geo/, jax-free). See docs/geo-replication.md.
 from .geo import GeoConfig  # noqa: E402
 
+# And for [transport]: the pmux internal-transport knobs (enable flag,
+# listener port offset, per-peer inflight cap, frame size ceiling,
+# handshake timeout) live with the mux module
+# (pilosa_tpu/server/mux.py, jax-free). See docs/transport.md.
+from .server.mux import TransportConfig  # noqa: E402
+
 
 @dataclass
 class MetricConfig:
@@ -182,6 +188,7 @@ class Config:
     obs: ObsConfig = field(default_factory=ObsConfig)
     cdc: CdcConfig = field(default_factory=CdcConfig)
     geo: GeoConfig = field(default_factory=GeoConfig)
+    transport: TransportConfig = field(default_factory=TransportConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     translation: TranslationConfig = field(default_factory=TranslationConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
@@ -311,6 +318,16 @@ class Config:
             "probe-promote", self.geo.probe_promote)
         self.geo.probe_failures = ge.get(
             "probe-failures", self.geo.probe_failures)
+        tr = d.get("transport", {})
+        self.transport.enabled = tr.get("enabled", self.transport.enabled)
+        self.transport.port_offset = tr.get(
+            "port-offset", self.transport.port_offset)
+        self.transport.max_frames_inflight = tr.get(
+            "max-frames-inflight", self.transport.max_frames_inflight)
+        self.transport.frame_max_bytes = tr.get(
+            "frame-max-bytes", self.transport.frame_max_bytes)
+        self.transport.handshake_timeout = tr.get(
+            "handshake-timeout", self.transport.handshake_timeout)
         s = d.get("scheduler", {})
         self.scheduler.max_queue = s.get("max-queue", self.scheduler.max_queue)
         self.scheduler.interactive_concurrency = s.get(
@@ -545,6 +562,16 @@ class Config:
             if v is not None:
                 setattr(self.geo, attr, v)
         for attr, name, cast in [
+            ("enabled", "TRANSPORT_ENABLED", bool),
+            ("port_offset", "TRANSPORT_PORT_OFFSET", int),
+            ("max_frames_inflight", "TRANSPORT_MAX_FRAMES_INFLIGHT", int),
+            ("frame_max_bytes", "TRANSPORT_FRAME_MAX_BYTES", int),
+            ("handshake_timeout", "TRANSPORT_HANDSHAKE_TIMEOUT", float),
+        ]:
+            v = env(name, cast)
+            if v is not None:
+                setattr(self.transport, attr, v)
+        for attr, name, cast in [
             ("max_queue", "SCHED_MAX_QUEUE", int),
             ("interactive_concurrency", "SCHED_INTERACTIVE_CONCURRENCY", int),
             ("batch_concurrency", "SCHED_BATCH_CONCURRENCY", int),
@@ -736,6 +763,13 @@ class Config:
             "geo_backoff_max": ("geo", "backoff_max"),
             "geo_probe_promote": ("geo", "probe_promote"),
             "geo_probe_failures": ("geo", "probe_failures"),
+            "transport_enabled": ("transport", "enabled"),
+            "transport_port_offset": ("transport", "port_offset"),
+            "transport_max_frames_inflight":
+                ("transport", "max_frames_inflight"),
+            "transport_frame_max_bytes": ("transport", "frame_max_bytes"),
+            "transport_handshake_timeout":
+                ("transport", "handshake_timeout"),
             "sched_max_queue": ("scheduler", "max_queue"),
             "sched_interactive_concurrency": ("scheduler", "interactive_concurrency"),
             "sched_batch_concurrency": ("scheduler", "batch_concurrency"),
@@ -896,6 +930,13 @@ class Config:
             f"probe-promote = {fmt(self.geo.probe_promote)}",
             f"probe-failures = {self.geo.probe_failures}",
             "",
+            "[transport]",
+            f"enabled = {fmt(self.transport.enabled)}",
+            f"port-offset = {self.transport.port_offset}",
+            f"max-frames-inflight = {self.transport.max_frames_inflight}",
+            f"frame-max-bytes = {self.transport.frame_max_bytes}",
+            f"handshake-timeout = {self.transport.handshake_timeout}",
+            "",
             "[scheduler]",
             f"max-queue = {self.scheduler.max_queue}",
             f"interactive-concurrency = {self.scheduler.interactive_concurrency}",
@@ -1030,6 +1071,7 @@ class Config:
             obs_config=self.obs.validate(),
             cdc_config=self.cdc.validate(),
             geo_config=self.geo.validate(),
+            transport_config=self.transport.validate(),
         )
         kw.update(overrides)
         return Server(**kw)

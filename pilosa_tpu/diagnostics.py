@@ -215,6 +215,30 @@ class DiagnosticsCollector:
                 1 for p in snap.get("peers", {}).values()
                 if p.get("state") != "closed"
             )
+        # Internal transport shape (docs/transport.md): how much
+        # node-to-node traffic rode the mux vs fell back to HTTP,
+        # connection churn, and the frame/byte totals — the aggregate
+        # answer to "did flipping [transport] on actually take the RTT
+        # tax off this node's hops" (per-peer detail stays in
+        # /debug/vars).
+        tstats = getattr(self.server, "transport_stats", None)
+        if tstats is not None:
+            snap = tstats.snapshot()
+            tcfg = getattr(self.server, "transport_config", None)
+            info["transportEnabled"] = bool(
+                tcfg.enabled) if tcfg is not None else False
+            info["transportConnects"] = snap.get("connects", 0)
+            info["transportReconnects"] = snap.get("reconnects", 0)
+            info["transportFramesSent"] = snap.get("frames_sent", 0)
+            info["transportFramesReceived"] = snap.get("frames_received", 0)
+            info["transportBytesSent"] = snap.get("bytes_sent", 0)
+            info["transportBytesReceived"] = snap.get("bytes_received", 0)
+            info["transportBatchedFrames"] = snap.get("batched_frames", 0)
+            info["transportHandshakeFallbacks"] = snap.get(
+                "handshake_fallbacks", 0)
+            info["transportInflightHwm"] = snap.get("inflight_hwm", 0)
+            info["transportRequestsMux"] = snap.get("requests_mux", 0)
+            info["transportRequestsHttp"] = snap.get("requests_http", 0)
         # Durable write replication shape (docs/durability.md): the
         # configured ack level and the hinted-handoff flow — writes a
         # replica missed that are queued, delivered, or expired to the

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from ..errors import PilosaError
 from .handler import deserialize_remote
+from .mux import MuxError, MuxUnavailable
 
 
 class ClientError(PilosaError):
@@ -67,6 +68,11 @@ class InternalClient:
         # Cluster shared secret (gossip.key analog): sent on every request;
         # peers with a key configured refuse unauthenticated /internal/*.
         self.key = key
+        # Optional mux.MuxTransport (docs/transport.md), installed by the
+        # owning Server when [transport] enabled: http-scheme requests
+        # ride persistent multiplexed frames, with per-peer HTTP fallback
+        # when the handshake fails (mixed / mux-disabled clusters).
+        self.mux = None
         # Per-thread keep-alive connection pool (see _conn). Every
         # thread's pool dict is also tracked in _pools so close() can
         # drain sockets owned by threads that no longer exist.
@@ -178,6 +184,33 @@ class InternalClient:
             headers["X-Pilosa-Key"] = self.key
         if extra_headers:
             headers.update(extra_headers)
+        if self.mux is not None and parts.scheme == "http":
+            try:
+                status, data, rheaders = self.mux.request(
+                    method, parts.netloc, path, body=body,
+                    content_type=content_type if body is not None else None,
+                    accept=accept, headers=extra_headers)
+            except MuxUnavailable:
+                # Disabled / peer demoted / handshake failed / oversized
+                # frame: routing, not an error — serve over plain HTTP.
+                if self.mux.stats is not None:
+                    self.mux.stats.bump("requests_http")
+            except MuxError as e:
+                # Same evidence shape as an HTTP socket fault: status 0
+                # feeds the breaker and the executor's replica-retry
+                # classification exactly like a connect failure.
+                self._local.transport = "mux"
+                raise ClientError(f"{method} {url}: {e}") from e
+            else:
+                self._local.transport = "mux"
+                if status >= 400:
+                    detail = data.decode(errors="replace")
+                    raise ClientError(
+                        f"{method} {url}: {status} {detail}", status=status)
+                if want_headers:
+                    return data, rheaders
+                return data
+        self._local.transport = "http"
         # Retry policy (one silent retry, always on a FRESH connection):
         #   - send-phase errors on a FRESHLY-OPENED connection: the peer
         #     provably never processed the request — retry any method;
@@ -246,6 +279,12 @@ class InternalClient:
                 return data, {k.lower(): v for k, v in resp.getheaders()}
             return data
 
+    def last_transport(self) -> str:
+        """Which path the calling thread's most recent _request rode —
+        'mux' or 'http'. query_node tags its remote span with it so
+        traces show per-hop which transport carried the request."""
+        return getattr(self._local, "transport", "http")
+
     # ---------------------------------------------------------------- query
 
     def query_node(self, node, index: str, query: str,
@@ -288,6 +327,7 @@ class InternalClient:
             "POST", url, body, accept=wire.CONTENT_TYPE,
             extra_headers=extra, want_headers=True)
         if trace is not None:
+            trace.tag(transport=self.last_transport())
             summary = resp_headers.get("x-pilosa-trace-summary")
             if summary:
                 trace.splice(summary)

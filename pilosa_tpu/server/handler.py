@@ -1043,6 +1043,24 @@ class Handler:
         geo = getattr(self.api.server, "geo", None)
         if geo is not None:
             out["geo"] = geo.debug_vars()
+        # pmux internal transport (docs/transport.md): connection churn,
+        # frame/byte totals, handshake fallbacks, inflight high-water —
+        # the on-call question after flipping [transport] on is "are
+        # hops actually riding the mux, and is any peer demoted to
+        # HTTP". Always present (the stats object exists even when
+        # disabled) so dashboards need no conditional.
+        tstats = getattr(self.api.server, "transport_stats", None)
+        if tstats is not None:
+            tr = tstats.snapshot()
+            tcfg = getattr(self.api.server, "transport_config", None)
+            tr["enabled"] = bool(tcfg.enabled) if tcfg is not None else False
+            mux_t = getattr(self.api.server, "mux_transport", None)
+            if mux_t is not None:
+                tr.update(mux_t.snapshot())
+            mux_s = getattr(self.api.server, "mux_server", None)
+            if mux_s is not None:
+                tr["server"] = mux_s.snapshot()
+            out["transport"] = tr
         # Per-query tracing health (docs/observability.md): sampler
         # counters, ring depth, slow-query count — the aggregate next to
         # the per-trace detail /debug/traces serves.

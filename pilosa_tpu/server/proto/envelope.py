@@ -26,6 +26,7 @@ import json
 from typing import Callable, Dict, Tuple
 
 from . import private_pb2 as pb
+from ..mux import split_host_port
 
 # Reference broadcast.go:52-69 type-byte order.
 TYPE_CREATE_SHARD = 0
@@ -68,29 +69,16 @@ def _encode_node(node_pb, d: dict) -> None:
     scheme = "http"
     if "://" in uri:
         scheme, uri = uri.split("://", 1)
-    host, port = uri, 0
-    if uri.startswith("["):
-        # Bracketed IPv6, '[::1]:10101' or '[::1]': brackets are wire
-        # syntax, not part of the address — URI.Host carries the bare
-        # address (reference uri.go parses the same way).
-        end = uri.find("]")
-        if end != -1:
-            host = uri[1:end]
-            rest = uri[end + 1:]
-            if rest.startswith(":"):
-                try:
-                    port = int(rest[1:])
-                except ValueError:
-                    port = 0
-    elif uri.count(":") == 1:
-        host, port_s = uri.rsplit(":", 1)
-        try:
-            port = int(port_s)
-        except ValueError:
-            host, port = uri, 0
-    # else: zero colons (plain host, no port) or 2+ colons (a bare
-    # unbracketed IPv6 address like '::1') — the whole string is the host;
-    # blind rsplit would have mangled '::1' into host ':' port 1.
+    # One splitter for the whole codebase (mux.split_host_port): the
+    # mux dialer and this codec must agree on bracketed '[::1]:10101'
+    # and bare '::1' IPv6 forms, so neither grows its own parse. A
+    # malformed netloc (unclosed bracket, non-numeric port) rides
+    # whole as the host — the reference's tolerant parse.
+    try:
+        host, port = split_host_port(uri)
+        port = port or 0
+    except ValueError:
+        host, port = uri, 0
     node_pb.URI.Scheme = scheme
     node_pb.URI.Host = host
     node_pb.URI.Port = port

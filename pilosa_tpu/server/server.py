@@ -74,6 +74,7 @@ class Server:
         obs_config=None,
         cdc_config=None,
         geo_config=None,
+        transport_config=None,
         join_addr: Optional[str] = None,
         allowed_origins: Optional[List[str]] = None,
         tls_certificate: Optional[str] = None,
@@ -219,6 +220,28 @@ class Server:
             timeout=member_probe_timeout, skip_verify=tls_skip_verify,
             key=self.internal_key,
         )
+        # [transport] pmux (docs/transport.md): persistent multiplexed
+        # binary frames for node-to-node traffic with per-peer HTTP
+        # fallback. The stats object always exists so the /debug/vars
+        # `transport` group is present even when disabled; the client
+        # half installs onto the SHARED InternalClient, so fan-out,
+        # write forwarding, hints, migration, and CDC tailing all ride
+        # the mux with zero call-site changes. The probe client stays
+        # HTTP-only: liveness probes should measure the fallback path
+        # a demoted peer would actually serve on.
+        from .mux import MuxTransport, TransportConfig, TransportStats
+
+        self.transport_config = (
+            transport_config or TransportConfig()).validate()
+        self.transport_stats = TransportStats()
+        self.mux_transport = None
+        self.mux_server = None
+        if self.transport_config.enabled:
+            self.mux_transport = MuxTransport(
+                self.transport_config, key=self.internal_key,
+                timeout=self.client.timeout, stats=self.transport_stats,
+            )
+            self.client.mux = self.mux_transport
         # [ingest] knobs consumed by the API's parallel import fan-out.
         from ..ingest import IngestConfig
 
@@ -346,6 +369,13 @@ class Server:
             self.api, logger=self.logger, allowed_origins=allowed_origins,
             internal_key=self.internal_key,
         )
+        if self.transport_config.enabled:
+            from .mux import MuxServer
+
+            self.mux_server = MuxServer(
+                self.handler, self.transport_config,
+                key=self.internal_key, stats=self.transport_stats,
+            )
 
         from ..cluster.topology import Topology
         from ..diagnostics import DiagnosticsCollector
@@ -470,6 +500,14 @@ class Server:
                 for n in self.cluster.nodes:
                     if n.id in saved_flags:
                         n.is_coordinator = saved_flags[n.id]
+
+        # pmux listener (docs/transport.md): opens on http_port +
+        # port-offset once the real HTTP port is known (tests bind port
+        # 0). A bind failure is survivable — peers' handshakes fail and
+        # they demote this node to HTTP.
+        if self.mux_server is not None:
+            self.mux_transport.node_uri = self.node.uri
+            self.mux_server.open(self.host, self.port)
 
         self.holder.open()
         if self._needs_topology_quorum():
@@ -766,6 +804,13 @@ class Server:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        # Mux halves before executor.close: tearing the transport down
+        # fails any pending waiters promptly instead of letting executor
+        # threads ride out full response timeouts.
+        if self.mux_server is not None:
+            self.mux_server.close()
+        if self.mux_transport is not None:
+            self.mux_transport.close()
         if self.collective is not None:
             self.collective.close()
         # Executor.close also drains the shared internal client's

@@ -96,7 +96,7 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
     assert not detail.get("partial"), detail.get("partial")
     assert parsed["value"] > 0
     stanzas = _registered_stanzas()
-    assert len(stanzas) >= 22  # the registry itself didn't shrink
+    assert len(stanzas) >= 23  # the registry itself didn't shrink
     for name in stanzas:
         stanza = detail.get(name.lower())
         assert isinstance(stanza, dict), f"stanza {name} missing: {stanza}"
@@ -291,6 +291,28 @@ def test_bench_smoke_runs_every_stanza(tmp_path):
         "MULTITENANT", mt,
         lambda m: m["isolation"]["quiet_p99_bounded"], tmp_path)
     assert mt["isolation"]["quiet_p99_bounded"], mt
+    # The TRANSPORT stanza is the pmux acceptance metric
+    # (docs/transport.md "Measured"): every internal hop in the mux leg
+    # must really ride mux (zero fallbacks, zero HTTP requests through
+    # the mux-attached client), the REPLICATION-shaped leg must drain
+    # its hints over mux with the replica count converged, and the
+    # REBALANCE-shaped migration-stream bytes must be transport-
+    # invariant. All correctness gates — never retried. The fan-out
+    # qps RATIO (mux >= 1.3x HTTP on the identical workload) is a
+    # timing gate: one isolation rerun per the TIER-flake precedent.
+    tp = detail["transport"]
+    assert tp["mux_counters"]["handshake_fallbacks"] == 0, tp
+    assert tp["mux_counters"]["requests_http"] == 0, tp
+    assert tp["mux_counters"]["requests_mux"] > 0, tp
+    assert tp["replication_leg"]["drained"], tp
+    assert tp["replication_leg"]["replica_count_ok"], tp
+    assert tp["replication_leg"]["total_count_ok"], tp
+    assert tp["rebalance_leg"]["bit_exact"], tp
+    assert tp["transport_ok"], tp
+    tp = _retry_ratio_gate(
+        "TRANSPORT", tp,
+        lambda t: t["mux_vs_http_qps"] >= 1.3, tmp_path)
+    assert tp["mux_vs_http_qps"] >= 1.3, tp
 
     # BENCH_OUT got the same line atomically.
     out_path = tmp_path / "bench_out.json"

@@ -212,6 +212,7 @@ JAX_FREE_ZONES = (
     "pilosa_tpu/plan/",
     "pilosa_tpu/cdc/",
     "pilosa_tpu/geo/",
+    "pilosa_tpu/server/mux.py",
 )
 
 
@@ -1472,6 +1473,8 @@ R11_SECTIONS: Dict[str, Tuple[str, str, str, str]] = {
     "CdcConfig": ("cdc", "cdc", "CDC", "docs/cdc.md"),
     "GeoConfig": ("geo", "geo", "GEO", "docs/geo-replication.md"),
     "QosConfig": ("qos", "qos", "QOS", "docs/scheduler.md"),
+    "TransportConfig": ("transport", "transport", "TRANSPORT",
+                        "docs/transport.md"),
     "AutoscaleConfig": ("autoscale", "autoscale", "AUTOSCALE",
                         "docs/rebalance.md"),
 }
