@@ -169,10 +169,13 @@ class InternalClient:
                  content_type: str = "application/json",
                  accept: Optional[str] = None,
                  extra_headers: Optional[Dict[str, str]] = None,
-                 want_headers: bool = False):
+                 want_headers: bool = False, idempotent: bool = False):
         """Returns the response body, or (body, lowercased-header-dict)
         when want_headers — the tracing path reads the peer's
-        X-Pilosa-Trace-Summary off the response."""
+        X-Pilosa-Trace-Summary off the response. ``idempotent`` marks a
+        POST whose replay is harmless (PQL forwards: WRITE_CALLS all
+        have value semantics) so the mux may retry it over HTTP when
+        the peer cannot fit the response in a frame."""
         parts = urllib.parse.urlsplit(url)
         path = parts.path + (f"?{parts.query}" if parts.query else "")
         headers = {}
@@ -189,7 +192,8 @@ class InternalClient:
                 status, data, rheaders = self.mux.request(
                     method, parts.netloc, path, body=body,
                     content_type=content_type if body is not None else None,
-                    accept=accept, headers=extra_headers)
+                    accept=accept, headers=extra_headers,
+                    idempotent=idempotent)
             except MuxUnavailable:
                 # Disabled / peer demoted / handshake failed / oversized
                 # frame: routing, not an error — serve over plain HTTP.
@@ -325,7 +329,7 @@ class InternalClient:
         extra = extra or None
         raw, resp_headers = self._request(
             "POST", url, body, accept=wire.CONTENT_TYPE,
-            extra_headers=extra, want_headers=True)
+            extra_headers=extra, want_headers=True, idempotent=True)
         if trace is not None:
             trace.tag(transport=self.last_transport())
             summary = resp_headers.get("x-pilosa-trace-summary")

@@ -101,8 +101,25 @@ _FIXED_REQ_FIELDS = (
 
 
 class MuxError(PilosaError):
-    """A mux request failed after the frame was (or may have been)
-    in flight. Callers surface it exactly like an HTTP socket error."""
+    """A mux request failed. Unless it is a MuxUnsent, the frame may
+    have been in flight (the combining writer can flush a caller's
+    frame in an earlier chunk before a later chunk's sendall fails),
+    so callers surface it exactly like an HTTP socket error and NEVER
+    silently replay a non-idempotent call on it."""
+
+
+class MuxUnsent(MuxError):
+    """The failure happened strictly BEFORE the frame was enqueued to
+    the writer: no byte of it was ever handed to a sendall, so the
+    peer provably never saw the call. This is the only MuxError a
+    non-idempotent request may be silently retried on — the exact
+    analogue of the HTTP client's fresh-connection rule."""
+
+
+class MuxFrameTooLarge(MuxUnsent):
+    """The frame exceeds frame-max-bytes (or a meta field exceeds the
+    64 KiB field cap). Raised before anything is enqueued; the
+    connection stays healthy and the caller routes around the mux."""
 
 
 class MuxProtocolError(MuxError):
@@ -234,7 +251,9 @@ def encode_meta(fields):
     parts = [struct.pack("!B", len(fields))]
     for fid, val in fields.items():
         if len(val) > 0xFFFF:
-            raise MuxError(f"meta field {fid} too large ({len(val)} bytes)")
+            raise MuxFrameTooLarge(
+                f"meta field {fid} too large ({len(val)} bytes)"
+            )
         parts.append(struct.pack("!BH", fid, len(val)))
         parts.append(val)
     return b"".join(parts)
@@ -288,21 +307,21 @@ class _FrameIO:
     def send_frame(self, kind, stream_id, meta_fields, payload):
         data = encode_frame(kind, stream_id, meta_fields, payload)
         if len(data) - HEADER_LEN > self.frame_max:
-            raise MuxError(
+            raise MuxFrameTooLarge(
                 f"frame of {len(data) - HEADER_LEN} bytes exceeds "
                 f"frame-max-bytes={self.frame_max}"
             )
         with self._wmu:
             if self._werr is not None:
-                raise MuxError(f"connection already failed: {self._werr}")
+                # The frame was never enqueued: provably unsent.
+                raise MuxUnsent(f"connection already failed: {self._werr}")
             self._wbuf.append(data)
             if self._flushing:
                 # Another thread is mid-flush; it will pick this frame
-                # up in its next combined sendall.
+                # up in its next combined sendall (and count it there,
+                # once that sendall succeeds).
                 if self.stats:
                     self.stats.bump("batched_frames")
-                    self.stats.bump("frames_sent")
-                    self.stats.bump("bytes_sent", len(data))
                 return
             self._flushing = True
         try:
@@ -311,20 +330,24 @@ class _FrameIO:
                     if not self._wbuf:
                         self._flushing = False
                         return
-                    chunk = b"".join(self._wbuf)
-                    self._wbuf = []
+                    frames, self._wbuf = self._wbuf, []
+                chunk = b"".join(frames)
                 self.sock.sendall(chunk)
-            # (unreachable)
+                # Counted only after the sendall that carried them
+                # succeeded — a failed flush must not inflate the wire
+                # counters the bench reads.
+                if self.stats:
+                    self.stats.bump("frames_sent", len(frames))
+                    self.stats.bump("bytes_sent", len(chunk))
         except OSError as e:
             with self._wmu:
                 self._werr = e
                 self._flushing = False
                 self._wbuf = []
+            # NOT MuxUnsent: this thread's own frame may have gone out
+            # in an earlier successful chunk of this flush loop, so the
+            # peer may already be dispatching it.
             raise MuxError(f"frame send failed: {e}") from e
-        finally:
-            if self.stats:
-                self.stats.bump("frames_sent")
-                self.stats.bump("bytes_sent", len(data))
 
     # -- read side
 
@@ -465,7 +488,8 @@ class _ClientConn:
         back to HTTP), MuxError when the connection is dead."""
         with self._mu:
             if self.closed:
-                raise MuxError("connection closed")
+                # Nothing was built, let alone enqueued.
+                raise MuxUnsent("connection closed")
             if len(self._waiters) >= self.config.max_frames_inflight:
                 raise MuxUnavailable(
                     f"{len(self._waiters)} frames inflight to {self.netloc} "
@@ -479,9 +503,16 @@ class _ClientConn:
                 self.stats.note_inflight(len(self._waiters))
         try:
             self.io.send_frame(KIND_CALL, sid, meta_fields, payload)
-        except MuxError:
+        except MuxError as e:
             with self._mu:
                 self._waiters.pop(sid, None)
+            if not isinstance(e, MuxUnsent):
+                # A flush failure kills the socket for everyone: frames
+                # other threads enqueued behind the failing chunk were
+                # dropped, so fail their waiters now instead of letting
+                # them hang until the reader notices the dead socket.
+                self._teardown(
+                    MuxError(f"mux send to {self.netloc} failed: {e}"))
             raise
         return sid, waiter
 
@@ -577,10 +608,23 @@ class MuxTransport:
             lock = self._dial_locks.setdefault(netloc, threading.Lock())
         with lock:
             with self._mu:
+                # Re-check under the dial lock: while this thread waited,
+                # another may have dialed (reuse its connection), failed
+                # and demoted the peer (honor the backoff instead of
+                # immediately re-dialing a down peer), or closed the
+                # whole transport.
+                if self._closed:
+                    raise MuxUnavailable("transport closed")
                 conn = self._conns.get(netloc)
                 if conn is not None and not conn.closed:
                     return conn
                 had_prior = conn is not None
+                until = self._demoted_until.get(netloc, 0.0)
+                if self.clock() < until:
+                    raise MuxUnavailable(
+                        f"peer {netloc} demoted to HTTP for "
+                        f"{until - self.clock():.1f}s more"
+                    )
             conn = self._dial(netloc, had_prior)
             with self._mu:
                 if self._closed:
@@ -613,7 +657,11 @@ class MuxTransport:
             io = _FrameIO(sock, self.config.frame_max_bytes, self.stats)
             hello = {
                 M_VERSION: str(MUX_VERSION).encode("ascii"),
-                M_KEY: self.key.encode("latin-1", "replace"),
+                # utf-8 on BOTH sides (the server compares the raw meta
+                # bytes against key.encode()): unlike HTTP headers the
+                # meta slot is binary-clean, so a non-latin-1 cluster
+                # key must not be mangled into a guaranteed mismatch.
+                M_KEY: self.key.encode("utf-8"),
             }
             if self.node_uri:
                 hello[M_NODE] = self.node_uri.encode("utf-8")
@@ -660,8 +708,14 @@ class MuxTransport:
     # -- request path
 
     def request(self, method, netloc, target, body=b"",
-                content_type=None, accept=None, headers=None):
+                content_type=None, accept=None, headers=None,
+                idempotent=False):
         """One multiplexed request/response over the peer connection.
+
+        ``idempotent=True`` marks a call whose replay is harmless even
+        though its method is POST (e.g. PQL forwards: every WRITE_CALL
+        has value semantics), widening the retry-over-HTTP escape for
+        undeliverable responses beyond GET/HEAD.
 
         -> (status:int, data:bytes, resp_headers:dict lowercased)
         """
@@ -693,12 +747,30 @@ class MuxTransport:
                 break
             except MuxUnavailable:
                 raise
-            except (MuxError, OSError) as e:
+            except MuxFrameTooLarge as e:
+                # The approx guard above under-counted; nothing was
+                # enqueued, so routing the request over HTTP is safe.
+                raise MuxUnavailable(str(e)) from e
+            except (MuxUnsent, OSError) as e:
+                # Provably unsent — the failure happened before any
+                # byte of the frame was handed to a sendall (failpoint,
+                # dial, dead-connection pre-check) — so ONE silent
+                # redial is safe for ANY method: the exact HTTP
+                # fresh-connection rule (client.py retry policy).
                 if attempt == 0:
                     continue
                 if isinstance(e, MuxError):
                     raise
                 raise MuxError(f"mux send to {netloc} failed: {e}") from e
+            except MuxError:
+                # NOT provably unsent: the combining writer may have
+                # flushed this frame in an earlier chunk before a later
+                # chunk failed, so the peer may already be dispatching
+                # the call. Mirror the HTTP pooled-connection policy —
+                # surface the error, never silently replay a
+                # possibly-dispatched (non-idempotent) call; upper
+                # layers own non-idempotent recovery.
+                raise
         if not waiter.event.wait(self.timeout):
             conn.abandon(_sid)
             # Slow is not torn: the connection stays up; only this
@@ -710,6 +782,18 @@ class MuxTransport:
         if isinstance(res, Exception):
             raise res
         _kind, meta, payload = res
+        if M_ERROR in meta:
+            # The server dispatched the call but could not carry the
+            # response over mux (it exceeded frame-max-bytes). Only
+            # idempotent methods may transparently replay over HTTP —
+            # the call DID run, so a non-idempotent replay could
+            # double-apply; those surface the error status below.
+            reason = meta[M_ERROR].decode("utf-8", "replace")
+            if idempotent or method.upper() in ("GET", "HEAD"):
+                raise MuxUnavailable(
+                    f"peer {netloc} could not answer over mux "
+                    f"({reason}); retrying over HTTP"
+                )
         self.stats.bump("requests_mux")
         try:
             status = int(meta.get(M_STATUS, b"0"))
@@ -821,7 +905,7 @@ class MuxServer:
             if kind != KIND_HELLO or payload != _MAGIC:
                 return  # not a pmux peer; drop silently
             peer_ver = int(meta.get(M_VERSION, b"0"))
-            offered = meta.get(M_KEY, b"").decode("latin-1")
+            offered = meta.get(M_KEY, b"")
             peer = meta.get(M_NODE, b"").decode("utf-8") or None
             if peer_ver != MUX_VERSION:
                 io.send_frame(KIND_HELLO_ACK, 0, {
@@ -829,7 +913,11 @@ class MuxServer:
                     M_ERROR: b"version mismatch",
                 }, b"")
                 return
-            if not hmac.compare_digest(offered, self.key):
+            # compare_digest on BYTES (handler.py does the same for the
+            # HTTP header): the str overload raises TypeError on
+            # non-ASCII input, which would crash the connection thread
+            # instead of rejecting the handshake.
+            if not hmac.compare_digest(offered, self.key.encode("utf-8")):
                 io.send_frame(KIND_HELLO_ACK, 0, {
                     M_VERSION: str(MUX_VERSION).encode("ascii"),
                     M_ERROR: b"cluster key mismatch",
@@ -851,7 +939,9 @@ class MuxServer:
         except MuxProtocolError as e:
             self.stats.bump("protocol_errors")
             logger.info("mux: tearing down connection from %s: %s", peer, e)
-        except (OSError, ValueError) as e:
+        except (MuxError, OSError, ValueError) as e:
+            # MuxError covers a failed HELLO_ACK send — without it the
+            # connection thread would die with an unhandled traceback.
             logger.info("mux: connection from %s failed: %s", peer, e)
         finally:
             with self._mu:
@@ -864,6 +954,9 @@ class MuxServer:
             target = meta.get(M_PATH, b"/").decode("utf-8")
             headers = _meta_to_headers(meta, self.key)
             path, _, qs = target.partition("?")
+            # Same normalization as the HTTP server (handler.py): a
+            # trailing slash must not 404 on one transport only.
+            path = path.rstrip("/") or "/"
             query = parse_qs(qs) if qs else {}
             result = self.handler.dispatch(
                 method, path, query, payload, headers=headers
@@ -893,6 +986,24 @@ class MuxServer:
             ).encode("utf-8")
         try:
             io.send_frame(KIND_RESP, sid, resp_meta, body or b"")
+        except MuxFrameTooLarge as e:
+            # The response doesn't fit a frame (frame-max-bytes or the
+            # 64 KiB meta-field cap). Nothing was enqueued and the
+            # connection is healthy, so answer with a SMALL error RESP:
+            # the client fails fast (or, for idempotent calls, retries
+            # over HTTP) instead of hanging its waiter until timeout
+            # and feeding the breaker a phantom transport fault.
+            err = json.dumps(
+                {"error": f"mux response undeliverable: {e}"}
+            ).encode("utf-8")
+            try:
+                io.send_frame(KIND_RESP, sid, {
+                    M_STATUS: b"500",
+                    M_CONTENT_TYPE: b"application/json",
+                    M_ERROR: b"resp-too-large",
+                }, err)
+            except MuxError as e2:
+                logger.info("mux: error response send failed: %s", e2)
         except MuxError as e:
             logger.info("mux: response send failed (peer gone?): %s", e)
 

@@ -43,12 +43,16 @@ from pilosa_tpu.server.mux import (
     MUX_VERSION,
     MuxClosed,
     MuxError,
+    MuxFrameTooLarge,
     MuxProtocolError,
     MuxUnavailable,
+    MuxUnsent,
     MuxServer,
     MuxTransport,
     TransportConfig,
+    TransportStats,
     _FrameIO,
+    _Waiter,
     _meta_to_headers,
     _req_meta,
     decode_meta,
@@ -263,6 +267,124 @@ def test_combining_writer_batches_queued_frames():
     assert gate.sends == [f1, f2 + f3], "queued frames did not combine"
 
 
+def test_flush_failure_is_maybe_sent_then_unsent():
+    """A sendall fault surfaces as plain MuxError (the frame may have
+    ridden an earlier chunk — NOT safe to replay); once the writer is
+    dead, subsequent sends never enqueue and are typed MuxUnsent."""
+    a, b = socket.socketpair()
+    io = _FrameIO(a, 1 << 20)
+    a.close()  # next sendall raises
+    with pytest.raises(MuxError) as ei:
+        io.send_frame(KIND_CALL, 1, {M_METHOD: b"GET"}, b"x")
+    assert not isinstance(ei.value, MuxUnsent)
+    with pytest.raises(MuxUnsent, match="connection already failed"):
+        io.send_frame(KIND_CALL, 2, {M_METHOD: b"GET"}, b"x")
+    b.close()
+
+
+def test_send_stats_only_bumped_on_successful_flush():
+    """frames_sent/bytes_sent count only frames whose sendall succeeded
+    — a failed flush must not inflate the wire counters."""
+    stats = TransportStats()
+    a, b = socket.socketpair()
+    io = _FrameIO(a, 1 << 20, stats)
+    io.send_frame(KIND_CALL, 1, {M_METHOD: b"GET"}, b"x")
+    assert stats.snapshot()["frames_sent"] == 1
+    sent_bytes = stats.snapshot()["bytes_sent"]
+    a.close()
+    with pytest.raises(MuxError):
+        io.send_frame(KIND_CALL, 2, {M_METHOD: b"GET"}, b"x")
+    snap = stats.snapshot()
+    assert snap["frames_sent"] == 1 and snap["bytes_sent"] == sent_bytes
+    b.close()
+
+
+def test_oversized_frame_is_typed_before_enqueue():
+    a, b = socket.socketpair()
+    io = _FrameIO(a, 4096)
+    with pytest.raises(MuxFrameTooLarge):
+        io.send_frame(KIND_CALL, 1, {}, b"x" * 8192)
+    # Connection stays healthy: a normal frame still goes out.
+    io.send_frame(KIND_CALL, 2, {}, b"ok")
+    a.close()
+    b.close()
+
+
+# --------------------------------------------- send-phase retry policy
+
+
+class _ScriptedConn:
+    """Stub _ClientConn: raises the scripted errors, then answers 200."""
+
+    closed = False
+
+    def __init__(self, errs):
+        self.errs = list(errs)
+        self.calls = 0
+
+    def send_call(self, meta_fields, payload):
+        self.calls += 1
+        if self.errs:
+            raise self.errs.pop(0)
+        w = _Waiter()
+        w.result = (KIND_RESP, {M_STATUS: b"200"}, b"ok")
+        w.event.set()
+        return 1, w
+
+    def abandon(self, sid):
+        pass
+
+
+def test_maybe_sent_failure_is_never_silently_retried(monkeypatch):
+    """The high-stakes rule: a MuxError raised AFTER the frame may have
+    hit the wire (combining-writer flush fault) must surface without a
+    redial — a replayed POST could double-apply a hint/cluster op the
+    peer already dispatched (mirrors the HTTP non-GET policy)."""
+    tr = MuxTransport(_cfg(), timeout=1.0)
+    conn = _ScriptedConn([MuxError("frame send failed: injected")])
+    monkeypatch.setattr(tr, "_conn", lambda netloc: conn)
+    try:
+        with pytest.raises(MuxError):
+            tr.request("POST", "localhost:1", "/internal/hints", body=b"op")
+        assert conn.calls == 1, "maybe-sent POST was silently replayed"
+    finally:
+        tr.close()
+
+
+def test_unsent_failure_gets_single_silent_redial(monkeypatch):
+    """MuxUnsent (pre-enqueue failure) is provably unsent: one silent
+    retry for ANY method, the HTTP fresh-connection parity."""
+    tr = MuxTransport(_cfg(), timeout=1.0)
+    conn = _ScriptedConn([MuxUnsent("connection closed")])
+    monkeypatch.setattr(tr, "_conn", lambda netloc: conn)
+    try:
+        status, data, _ = tr.request(
+            "POST", "localhost:1", "/internal/hints", body=b"op")
+        assert (status, data, conn.calls) == (200, b"ok", 2)
+        # A persistently-unsent failure still surfaces after the one
+        # retry.
+        conn.errs = [MuxUnsent("connection closed")] * 2
+        with pytest.raises(MuxUnsent):
+            tr.request("POST", "localhost:1", "/internal/hints", body=b"op")
+    finally:
+        tr.close()
+
+
+def test_frame_too_large_from_send_falls_back_to_http(monkeypatch):
+    """When the pre-send size guard under-counts, the typed
+    MuxFrameTooLarge (nothing enqueued) converts to MuxUnavailable so
+    the request safely rides HTTP instead of failing."""
+    tr = MuxTransport(_cfg(), timeout=1.0)
+    conn = _ScriptedConn([MuxFrameTooLarge("frame of 9999 bytes exceeds")])
+    monkeypatch.setattr(tr, "_conn", lambda netloc: conn)
+    try:
+        with pytest.raises(MuxUnavailable):
+            tr.request("POST", "localhost:1", "/import", body=b"op")
+        assert conn.calls == 1
+    finally:
+        tr.close()
+
+
 # ------------------------------------- client/server halves, real sockets
 
 
@@ -459,6 +581,8 @@ class FakeHandler:
             assert self.gate.wait(10.0)
         if path == "/boom":
             raise RuntimeError("kapow")
+        if path == "/big":
+            return (200, "application/octet-stream", b"x" * 8192)
         if path == "/echo":
             return (200, "application/octet-stream", body, {"X-Extra": "1"})
         return (200, "application/json",
@@ -506,6 +630,112 @@ def test_mux_request_end_to_end():
     finally:
         tr.close()
         srv.close()
+
+
+def test_trailing_slash_path_normalized_like_http():
+    """The mux server applies the HTTP server's path normalization, so
+    an internal URL with a trailing slash routes identically on both
+    transports."""
+    h = FakeHandler()
+    srv, netloc = _real_mux_server(handler=h)
+    tr = MuxTransport(_cfg(), timeout=5.0)
+    try:
+        status, data, _ = tr.request("GET", netloc, "/echo/?x=1")
+        assert status == 200
+        _, path, query, _, _ = h.calls[0]
+        assert path == "/echo"
+        assert query == {"x": ["1"]}
+    finally:
+        tr.close()
+        srv.close()
+
+
+def test_oversized_response_fails_fast_not_timeout():
+    """A response bigger than frame-max-bytes must not hang the waiter
+    until timeout: the server answers with a small error RESP. A GET
+    (idempotent) transparently falls back to HTTP (MuxUnavailable); a
+    POST surfaces a fast 500 — the call DID run, so replaying it is
+    not safe."""
+    cfg = _cfg(frame_max_bytes=4096)
+    h = FakeHandler()
+    srv, netloc = _real_mux_server(handler=h, config=cfg)
+    tr = MuxTransport(_cfg(frame_max_bytes=4096), timeout=30.0)
+    try:
+        start = time.monotonic()
+        with pytest.raises(MuxUnavailable, match="retrying over HTTP"):
+            tr.request("GET", netloc, "/big")
+        status, data, _ = tr.request("POST", netloc, "/big", body=b"go")
+        assert status == 500 and b"undeliverable" in data
+        # A POST whose replay is harmless (PQL query forward) opts into
+        # the same HTTP escape via the idempotent hint.
+        with pytest.raises(MuxUnavailable, match="retrying over HTTP"):
+            tr.request("POST", netloc, "/big", body=b"go", idempotent=True)
+        assert time.monotonic() - start < 10.0, "waiter hung until timeout"
+        # The connection survived: a fitting response still serves.
+        assert tr.request("GET", netloc, "/fast")[0] == 200
+    finally:
+        tr.close()
+        srv.close()
+
+
+def test_non_ascii_cluster_key_handshake():
+    """The key rides the binary meta slot as utf-8 and the server
+    compares BYTES: a non-ASCII key handshakes fine (no TypeError
+    crashing the connection thread), and a mismatch is a clean
+    rejection + demotion."""
+    srv, netloc = _real_mux_server(key="clé-秘密")
+    tr = MuxTransport(_cfg(), key="clé-秘密", timeout=5.0)
+    tr2 = MuxTransport(_cfg(), key="clé-秘密-wrong", timeout=5.0)
+    try:
+        assert tr.request("GET", netloc, "/s")[0] == 200
+        with pytest.raises(MuxUnavailable, match="key mismatch"):
+            tr2.request("GET", netloc, "/s")
+        assert tr2.stats.snapshot()["handshake_fallbacks"] == 1
+    finally:
+        tr.close()
+        tr2.close()
+        srv.close()
+
+
+def test_demotion_honored_after_waiting_on_dial_lock():
+    """A thread parked on the per-netloc dial lock while another
+    thread's dial fails must honor the fresh demotion instead of
+    immediately re-dialing the down peer (breaker-style backoff)."""
+    clock = FakeClock()
+    tr = MuxTransport(_cfg(), timeout=1.0, clock=clock)
+    dials = []
+
+    def fake_dial(netloc, had_prior):
+        dials.append(netloc)
+        raise MuxUnavailable("should not dial")
+
+    tr._dial = fake_dial
+    netloc = "peer:1"
+    lock = tr._dial_locks.setdefault(netloc, threading.Lock())
+    result = {}
+
+    def go():
+        try:
+            tr._conn(netloc)
+        except Exception as e:  # noqa: BLE001 - recording for assert
+            result["e"] = e
+
+    lock.acquire()
+    try:
+        t = threading.Thread(target=go, daemon=True)
+        t.start()
+        # Let the worker pass the pre-lock checks and park on the lock.
+        time.sleep(0.2)
+        # Another thread's dial "failed": the peer is now demoted.
+        with tr._mu:
+            tr._demoted_until[netloc] = clock() + 5.0
+    finally:
+        lock.release()
+    t.join(5.0)
+    assert isinstance(result.get("e"), MuxUnavailable)
+    assert "demoted" in str(result["e"])
+    assert dials == [], "re-dialed a freshly-demoted peer"
+    tr.close()
 
 
 def test_multiplexed_out_of_order_responses_share_one_socket():
